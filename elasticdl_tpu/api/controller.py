@@ -1,0 +1,173 @@
+"""Wrap-your-own-loop elasticity — the ``elasticai_api`` analog.
+
+Parity with elasticai_api/common/base_controller.py:48-186 and
+elasticai_api/pytorch/controller.py:97-203, redesigned for JAX: instead of
+re-initializing a Horovod ring, a rendezvous-epoch change triggers
+``jax.distributed`` re-initialization (multi-host) and/or a trainer
+``rebuild`` over the new mesh, which re-shards state and re-compiles the
+step.  The fixed-global-batch rule is the reference's
+``backward_passes_per_step`` math: per-worker accumulation count =
+global_batch_num // world_size, +1 for ranks < remainder
+(pytorch/controller.py:186-198).
+"""
+
+import functools
+import time
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_SECS_TO_CHECK_RENDEZVOUS = 20.0
+
+
+def compute_accum_steps(global_batch_num, rank, world_size):
+    """Microbatch count for one worker under a fixed global batch."""
+    if world_size <= 0:
+        return global_batch_num
+    base = global_batch_num // world_size
+    remainder = global_batch_num % world_size
+    return max(1, base + (1 if rank < remainder else 0))
+
+
+class RendezvousManager:
+    """Tracks the master's membership epoch for this worker."""
+
+    def __init__(self, master_client):
+        self._mc = master_client
+        self.rendezvous_id = -1
+        self.rank = -1
+        self.world_size = 0
+        self.coordinator_addr = ""
+
+    def poll(self, wait=True, poll_secs=0.5, timeout=120.0):
+        """Refresh (rank, world). Returns True if the epoch changed."""
+        deadline = time.time() + timeout
+        while True:
+            res = self._mc.get_comm_rank()
+            if res.rank_id >= 0 or not wait:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    "worker never entered the rendezvous world"
+                )
+            time.sleep(poll_secs)
+        changed = res.rendezvous_id != self.rendezvous_id
+        self.rendezvous_id = res.rendezvous_id
+        self.rank = res.rank_id
+        self.world_size = res.world_size
+        self.coordinator_addr = res.coordinator_addr
+        return changed
+
+
+class ElasticCollectiveController:
+    """Init-once, re-rendezvous-periodically, retry-on-failure loop driver.
+
+    Usage (mirrors the reference's ``elastic_run`` pattern):
+
+        controller = ElasticCollectiveController(mc, trainer, shard_service,
+                                                 global_batch_num=8)
+        elastic_train = controller.elastic_run(train_one_batch)
+        with controller.scope():
+            for batch in batches:
+                elastic_train(batch)
+    """
+
+    def __init__(
+        self,
+        master_client,
+        trainer,
+        data_shard_service=None,
+        global_batch_num=1,
+        check_secs=DEFAULT_SECS_TO_CHECK_RENDEZVOUS,
+        mesh_builder=None,
+        max_retries=3,
+    ):
+        self._mc = master_client
+        self._trainer = trainer
+        self._shard_service = data_shard_service
+        self._global_batch_num = global_batch_num
+        self._check_secs = check_secs
+        self._mesh_builder = mesh_builder
+        self._max_retries = max_retries
+        self._rendezvous = RendezvousManager(master_client)
+        self._last_check = 0.0
+        self._first_init_done = False
+
+    # -- world management ---------------------------------------------------
+
+    def _reinit_world(self):
+        rdzv = self._rendezvous
+        logger.info(
+            "world epoch %d: rank=%d world=%d",
+            rdzv.rendezvous_id, rdzv.rank, rdzv.world_size,
+        )
+        if self._mesh_builder is not None:
+            # Multi-host path: the builder may call
+            # jax.distributed.initialize(coordinator, world, rank) and
+            # construct the new global mesh.
+            mesh = self._mesh_builder(
+                rdzv.rank, rdzv.world_size, rdzv.coordinator_addr
+            )
+            self._trainer.rebuild(mesh)
+        accum = compute_accum_steps(
+            self._global_batch_num, rdzv.rank, rdzv.world_size
+        )
+        if hasattr(self._trainer, "set_accum_steps"):
+            self._trainer.set_accum_steps(accum)
+
+    def init_world_if_needed(self, force=False):
+        now = time.time()
+        if not force and now - self._last_check < self._check_secs:
+            return False
+        self._last_check = now
+        changed = self._rendezvous.poll(wait=not self._first_init_done)
+        if changed or not self._first_init_done:
+            self._reinit_world()
+            self._first_init_done = True
+            return True
+        return False
+
+    # -- loop driver ----------------------------------------------------------
+
+    def elastic_run(self, func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            self.init_world_if_needed()
+            err = None
+            for _ in range(self._max_retries):
+                try:
+                    result = func(*args, **kwargs)
+                    if self._shard_service is not None:
+                        self._shard_service.report_batch_done()
+                    return result
+                except Exception as e:  # noqa: BLE001 — comm failures
+                    err = e
+                    logger.warning(
+                        "step failed (%s); re-rendezvousing and retrying", e
+                    )
+                    time.sleep(1.0)
+                    self.init_world_if_needed(force=True)
+            raise RuntimeError(
+                "step failed after %d re-rendezvous retries"
+                % self._max_retries
+            ) from err
+
+        return wrapper
+
+    class _Scope:
+        def __init__(self, mc):
+            self._mc = mc
+
+        def __enter__(self):
+            self._mc.report_train_loop_status(pb.LOOP_START)
+            return self
+
+        def __exit__(self, *exc):
+            self._mc.report_train_loop_status(pb.LOOP_END)
+            return False
+
+    def scope(self):
+        """Joins/leaves the rendezvous world around the training loop."""
+        return self._Scope(self._mc)

@@ -1,0 +1,40 @@
+"""Data reader factory (parity:
+elasticdl/python/data/reader/data_reader_factory.py:23-79)."""
+
+import os
+
+
+def create_data_reader(data_origin, records_per_shard=256, **kwargs):
+    if data_origin.startswith("synthetic_mnist"):
+        from elasticdl_tpu.data.reader import ArrayDataReader
+        from elasticdl_tpu.models import mnist
+
+        _, _, n = data_origin.partition(":")
+        xs, ys = mnist.synthetic_data(n=int(n) if n else 2048)
+        return ArrayDataReader(
+            (xs, ys), records_per_shard=records_per_shard
+        )
+    if data_origin.startswith("synthetic_cifar10"):
+        from elasticdl_tpu.data.reader import ArrayDataReader
+        import numpy as np
+
+        _, _, n = data_origin.partition(":")
+        n = int(n) if n else 2048
+        rng = np.random.RandomState(0)
+        xs = rng.rand(n, 32, 32, 3).astype(np.float32)
+        ys = rng.randint(0, 10, size=n).astype(np.int32)
+        return ArrayDataReader((xs, ys), records_per_shard=records_per_shard)
+    if data_origin.endswith(".csv"):
+        from elasticdl_tpu.data.reader import TextDataReader
+
+        return TextDataReader(
+            data_origin, records_per_task=records_per_shard,
+            skip_header=kwargs.get("skip_header", False),
+        )
+    if os.path.isdir(data_origin):
+        from elasticdl_tpu.data.reader import RecioDataReader
+
+        return RecioDataReader(
+            data_origin, decode_fn=kwargs.get("decode_fn")
+        )
+    raise ValueError("cannot infer a data reader for %r" % data_origin)

@@ -1,0 +1,93 @@
+"""Version-triggered evaluation jobs.
+
+Parity with elasticdl/python/master/evaluation_service.py:21-167: the PS (or
+collective trainer) reports model versions; every ``evaluation_steps``
+versions the master enqueues evaluation tasks at that version, workers run
+forward passes and report (outputs, labels), and the master folds them into
+streaming metrics.
+"""
+
+import threading
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class EvaluationJob:
+    def __init__(self, metrics, model_version, total_tasks):
+        self.model_version = model_version
+        self._metrics = metrics
+        self._total_tasks = total_tasks
+        self._completed_tasks = 0
+
+    def complete_task(self):
+        self._completed_tasks += 1
+
+    def finished(self):
+        return self._completed_tasks >= self._total_tasks
+
+    def report_evaluation_metrics(self, outputs, labels):
+        for metric in self._metrics.values():
+            metric.update(outputs, labels)
+
+    def results(self):
+        return {name: m.result() for name, m in self._metrics.items()}
+
+
+class EvaluationService:
+    def __init__(self, task_manager, metrics_factory, evaluation_steps=0):
+        """metrics_factory() -> {name: Metric} builds fresh metrics per job."""
+        self._task_manager = task_manager
+        self._metrics_factory = metrics_factory
+        self._evaluation_steps = evaluation_steps
+        self._lock = threading.Lock()
+        self._job = None
+        self._last_eval_version = -1
+        self.history = []  # [(model_version, {metric: value})]
+
+    def add_evaluation_task_if_needed(self, model_version):
+        if self._evaluation_steps <= 0:
+            return False
+        with self._lock:
+            if (
+                model_version // self._evaluation_steps
+                <= self._last_eval_version // max(1, self._evaluation_steps)
+                and self._last_eval_version >= 0
+            ):
+                return False
+            if self._job is not None and not self._job.finished():
+                return False
+            total = self._task_manager.create_evaluation_tasks(model_version)
+            if total == 0:
+                return False
+            self._job = EvaluationJob(
+                self._metrics_factory(), model_version, total
+            )
+            self._last_eval_version = model_version
+            logger.info(
+                "evaluation job created at version %d (%d tasks)",
+                model_version, total,
+            )
+            return True
+
+    def report_evaluation_metrics(self, outputs, labels):
+        with self._lock:
+            if self._job is None:
+                return False
+            self._job.report_evaluation_metrics(outputs, labels)
+            return True
+
+    def complete_task(self):
+        with self._lock:
+            if self._job is None:
+                return
+            self._job.complete_task()
+            if self._job.finished():
+                results = self._job.results()
+                self.history.append((self._job.model_version, results))
+                logger.info(
+                    "evaluation @ version %d: %s",
+                    self._job.model_version,
+                    {k: round(v, 6) for k, v in results.items()},
+                )

@@ -1,0 +1,103 @@
+"""Master entrypoint (parity: elasticdl/python/master/main.py:20-24).
+
+Builds the control plane from flags, optionally launches/manages workers
+(local-process backend), runs the job to completion.
+"""
+
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.master.worker_manager import (
+    ProcessWorkerBackend,
+    WorkerManager,
+)
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.utils.args import (
+    build_arguments_from_parsed_result,
+    parse_master_args,
+)
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_MASTER_ONLY_ARGS = (
+    "port", "num_workers", "num_ps", "shuffle", "shuffle_shards",
+    "max_task_retries", "task_timeout_secs", "relaunch_on_worker_failure",
+)
+
+
+def build_master(args):
+    records_per_task = args.batch_size * args.num_minibatches_per_task
+    reader = create_data_reader(
+        args.data_origin, records_per_shard=records_per_task
+    )
+    eval_reader = None
+    if args.validation_data_origin:
+        eval_reader = create_data_reader(
+            args.validation_data_origin, records_per_shard=records_per_task
+        )
+    task_manager = TaskManager(
+        training_shards=reader.create_shards(),
+        evaluation_shards=(
+            eval_reader.create_shards() if eval_reader else None
+        ),
+        records_per_task=records_per_task,
+        num_epochs=args.num_epochs,
+        shuffle=args.shuffle,
+        shuffle_shards=args.shuffle_shards,
+        max_task_retries=args.max_task_retries,
+        task_timeout_secs=args.task_timeout_secs,
+        seed=args.seed,
+    )
+    spec = load_model_spec(args.model_zoo)
+    evaluation_service = None
+    if (
+        args.evaluation_steps
+        and eval_reader is not None
+        and spec.eval_metrics_fn is not None
+    ):
+        evaluation_service = EvaluationService(
+            task_manager,
+            spec.eval_metrics_fn,
+            evaluation_steps=args.evaluation_steps,
+        )
+    if spec.callbacks:
+        # One worker runs on_train_end (model export) after the last
+        # training task (reference: deferred train-end task,
+        # task_manager.py:35-68 + callbacks.py:23-66).
+        task_manager.set_train_end_callback_task()
+    rendezvous = (
+        RendezvousServer()
+        if args.distribution_strategy == "collective" else None
+    )
+    worker_manager = None
+    if args.num_workers > 0:
+        worker_args = build_arguments_from_parsed_result(
+            args, filter_args=_MASTER_ONLY_ARGS
+        )
+        worker_manager = WorkerManager(
+            ProcessWorkerBackend(worker_args=worker_args),
+            num_workers=args.num_workers,
+            max_relaunch_count=args.relaunch_on_worker_failure,
+        )
+    return Master(
+        task_manager,
+        rendezvous_server=rendezvous,
+        evaluation_service=evaluation_service,
+        worker_manager=worker_manager,
+        port=args.port,
+    )
+
+
+def main(argv=None):
+    args = parse_master_args(argv)
+    logger.info("master starting: %s", vars(args))
+    master = build_master(args)
+    master.prepare()
+    return master.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

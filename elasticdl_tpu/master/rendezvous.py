@@ -1,0 +1,89 @@
+"""Elastic collective membership — rendezvous epochs.
+
+TPU-native replacement for the master-hosted Horovod rendezvous
+(elasticdl/python/master/rendezvous_server.py:34-167).  Where Horovod
+rebuilds a Gloo ring, JAX bakes the device mesh into the compiled step; so
+membership changes are modeled as *epochs*: any join/leave bumps
+``rendezvous_id``, and workers observing a new id tear down their collective
+context (jax.distributed / compiled-step cache) and rebuild it for the new
+world.  Joins are batched behind a short grace window so a burst of
+relaunched workers triggers one re-compile, not many.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class RendezvousServer:
+    def __init__(self, grace_secs=2.0):
+        self._lock = threading.Lock()
+        self._grace_secs = grace_secs
+        self._cur_hosts = []     # committed world, sorted by join order
+        self._next_hosts = []    # pending world
+        self._rendezvous_id = 0
+        self._last_change = None
+        self._coordinator_addr = ""
+
+    def set_coordinator_addr(self, addr):
+        self._coordinator_addr = addr
+
+    @property
+    def rendezvous_id(self):
+        with self._lock:
+            return self._rendezvous_id
+
+    @property
+    def world(self):
+        with self._lock:
+            return list(self._cur_hosts)
+
+    def add_worker(self, host):
+        with self._lock:
+            if host not in self._next_hosts:
+                self._next_hosts.append(host)
+                self._last_change = time.time()
+                logger.info("rendezvous: worker %s joining", host)
+
+    def remove_worker(self, host):
+        with self._lock:
+            if host in self._next_hosts:
+                self._next_hosts.remove(host)
+                self._last_change = time.time()
+                logger.info("rendezvous: worker %s leaving", host)
+
+    def _maybe_commit(self):
+        # caller holds the lock
+        if (
+            self._next_hosts != self._cur_hosts
+            and self._last_change is not None
+            and time.time() - self._last_change >= self._grace_secs
+        ):
+            self._cur_hosts = list(self._next_hosts)
+            self._rendezvous_id += 1
+            logger.info(
+                "rendezvous epoch %d: world=%s",
+                self._rendezvous_id, self._cur_hosts,
+            )
+
+    def get_comm_rank(self, host):
+        """Return (rank, world_size, rendezvous_id, coordinator_addr).
+
+        rank == -1 means the host is not (yet) in the committed world and
+        should keep polling.
+        """
+        with self._lock:
+            self._maybe_commit()
+            if host in self._cur_hosts:
+                rank = self._cur_hosts.index(host)
+            else:
+                rank = -1
+            return (
+                rank,
+                len(self._cur_hosts),
+                self._rendezvous_id,
+                self._coordinator_addr,
+            )

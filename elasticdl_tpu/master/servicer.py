@@ -1,0 +1,144 @@
+"""Master gRPC servicer.
+
+Implements the control-plane RPCs (parity with
+elasticdl/python/master/servicer.py:61-198): task dispatch with WAIT-task
+logic for idle workers, task result accounting, rendezvous rank queries,
+train-loop membership, evaluation metric ingestion and version reports.
+"""
+
+import threading
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.proto import rpc
+from elasticdl_tpu.utils import grpc_utils, tensor_codec
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.master.task_manager import wait_task_pb
+
+logger = get_logger(__name__)
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager,
+        rendezvous_server=None,
+        evaluation_service=None,
+        worker_manager=None,
+    ):
+        self._task_manager = task_manager
+        self._rendezvous = rendezvous_server
+        self._evaluation_service = evaluation_service
+        self._worker_manager = worker_manager
+        self._lock = threading.Lock()
+        self._version = 0
+        self.training_params = None
+        self.worker_record_counts = {}  # worker_id -> records processed
+
+    @property
+    def model_version(self):
+        with self._lock:
+            return self._version
+
+    # -- task dispatch ------------------------------------------------------
+
+    def get_task(self, request, _context=None):
+        res = pb.GetTaskResponse()
+        task = self._task_manager.get(request.worker_id)
+        if task is not None:
+            task.to_pb(out=res.task)
+            return res
+        if not self._task_manager.finished():
+            # Work may reappear (retries, new epochs, eval jobs): park the
+            # worker instead of letting it exit.
+            res.task.CopyFrom(wait_task_pb())
+        else:
+            res.task.id = -1
+            res.task.type = pb.TRAINING  # no more work: worker exits
+        return res
+
+    def report_task_result(self, request, _context=None):
+        success = not request.err_message
+        result = self._task_manager.report(
+            request.task_id, success, request.err_message
+        )
+        if (
+            self._evaluation_service is not None
+            and result.task is not None
+            and result.task.type == pb.EVALUATION
+            # A permanently-failed eval task must still count toward job
+            # completion, or one bad shard wedges evaluation forever.
+            and (result.ok or result.permanent_failure)
+        ):
+            self._evaluation_service.complete_task()
+        return pb.Empty()
+
+    def report_batch_done(self, request, _context=None):
+        with self._lock:
+            prev = self.worker_record_counts.get(request.worker_id, 0)
+            self.worker_record_counts[request.worker_id] = (
+                prev + request.record_count
+            )
+        return pb.Empty()
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def get_comm_rank(self, request, _context=None):
+        res = pb.GetCommRankResponse()
+        if self._rendezvous is None:
+            res.rank_id = -1
+            return res
+        rank, size, rdzv_id, coord = self._rendezvous.get_comm_rank(
+            request.worker_host
+        )
+        res.rank_id = rank
+        res.world_size = size
+        res.rendezvous_id = rdzv_id
+        res.coordinator_addr = coord
+        return res
+
+    def report_train_loop_status(self, request, _context=None):
+        if self._rendezvous is not None:
+            if request.status == pb.LOOP_START:
+                self._rendezvous.add_worker(request.worker_host)
+            elif request.status == pb.LOOP_END:
+                self._rendezvous.remove_worker(request.worker_host)
+        return pb.Empty()
+
+    # -- evaluation / versions ---------------------------------------------
+
+    def report_evaluation_metrics(self, request, _context=None):
+        if self._evaluation_service is not None:
+            outputs = {
+                k: tensor_codec.pb_to_ndarray(v)
+                for k, v in request.model_outputs.items()
+            }
+            labels = tensor_codec.pb_to_ndarray(request.labels)
+            if len(outputs) == 1:
+                outputs = next(iter(outputs.values()))
+            self._evaluation_service.report_evaluation_metrics(
+                outputs, labels
+            )
+        return pb.Empty()
+
+    def report_version(self, request, _context=None):
+        with self._lock:
+            self._version = max(self._version, request.model_version)
+        if self._evaluation_service is not None:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                request.model_version
+            )
+        return pb.Empty()
+
+    def report_training_params(self, request, _context=None):
+        self.training_params = request
+        return pb.Empty()
+
+
+def create_master_service(servicer, port=0, max_workers=64):
+    """Start an in-process gRPC master service; returns (server, port)."""
+    server = grpc_utils.build_server(max_workers=max_workers)
+    rpc.add_master_servicer(servicer, server)
+    bound = server.add_insecure_port("[::]:%d" % port)
+    server.start()
+    logger.info("master service listening on port %d", bound)
+    return server, bound

@@ -1,0 +1,321 @@
+"""Dynamic data sharding — the heart of elasticity.
+
+The TaskManager partitions the dataset into shards and hands them out as
+tasks; any task owned by a dead worker goes back on the todo queue, which is
+what lets workers die and join freely.  Semantics match the reference's
+task manager (elasticdl/python/master/task_manager.py:35-616): todo/doing
+queues, <=3 retries per task, per-epoch regeneration with optional shuffle,
+a timeout watchdog, version-triggered evaluation tasks and a deferred
+train-end callback task.
+"""
+
+import random
+import threading
+import time
+from collections import deque, namedtuple
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+MAX_TASK_RETRIES = 3
+TASK_TIMEOUT_THRESHOLD_SECS = 300
+
+# Result of TaskManager.report: task is None for unknown ids;
+# permanent_failure marks a task that exhausted its retries.
+ReportResult = namedtuple("ReportResult", ["ok", "task", "permanent_failure"])
+
+
+class Shard:
+    __slots__ = ("name", "start", "end", "record_indices")
+
+    def __init__(self, name, start, end, record_indices=None):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.record_indices = record_indices or []
+
+    @property
+    def size(self):
+        return self.end - self.start
+
+    def to_pb(self, out=None):
+        s = out if out is not None else pb.ShardPB()
+        s.name = self.name
+        s.start = self.start
+        s.end = self.end
+        del s.record_indices[:]
+        s.record_indices.extend(self.record_indices)
+        return s
+
+
+class Task:
+    __slots__ = ("id", "shard", "type", "model_version", "retry_count")
+
+    def __init__(self, task_id, shard, task_type, model_version=-1):
+        self.id = task_id
+        self.shard = shard
+        self.type = task_type
+        self.model_version = model_version
+        self.retry_count = 0
+
+    def to_pb(self, out=None):
+        t = out if out is not None else pb.TaskPB()
+        t.id = self.id
+        t.type = self.type
+        self.shard.to_pb(out=t.shard)
+        t.model_version = self.model_version
+        return t
+
+
+def wait_task_pb():
+    return pb.TaskPB(id=-1, type=pb.WAIT)
+
+
+class TaskManager:
+    """Thread-safe todo/doing task queues over dataset shards."""
+
+    def __init__(
+        self,
+        training_shards=None,
+        evaluation_shards=None,
+        prediction_shards=None,
+        records_per_task=None,
+        num_epochs=1,
+        shuffle=False,
+        shuffle_shards=False,
+        max_task_retries=MAX_TASK_RETRIES,
+        task_timeout_secs=TASK_TIMEOUT_THRESHOLD_SECS,
+        seed=None,
+    ):
+        self._lock = threading.Lock()
+        self._training_shards = list(training_shards or [])
+        self._evaluation_shards = list(evaluation_shards or [])
+        self._prediction_shards = list(prediction_shards or [])
+        self._records_per_task = records_per_task
+        self._num_epochs = num_epochs
+        self._shuffle = shuffle
+        self._shuffle_shards = shuffle_shards
+        self._max_task_retries = max_task_retries
+        self._task_timeout_secs = task_timeout_secs
+        self._rng = random.Random(seed)
+
+        self._todo = deque()
+        # task_id -> (worker_id, task, start_time)
+        self._doing = {}
+        self._task_id = 0
+        self._epoch = 0
+        self._train_end_callback_pending = False
+        self._train_end_callback_done = False
+        self._max_task_completed_time = 0.0
+        self.completed_counts = {t: 0 for t in
+                                 (pb.TRAINING, pb.EVALUATION, pb.PREDICTION,
+                                  pb.TRAIN_END_CALLBACK)}
+        self.failed_counts = dict(self.completed_counts)
+        self._worker_timeout_callbacks = []
+        self._watchdog = None
+        self._stopped = threading.Event()
+
+        if self._training_shards:
+            logger.info(
+                "TaskManager: %d training shards, %d epochs",
+                len(self._training_shards), num_epochs,
+            )
+            self._create_training_tasks()
+        elif self._prediction_shards:
+            self._create_tasks(self._prediction_shards, pb.PREDICTION)
+
+    # -- task creation ------------------------------------------------------
+
+    def _split(self, shards):
+        """Split (name, start, end) ranges into records_per_task chunks."""
+        out = []
+        for name, start, end in shards:
+            if not self._records_per_task:
+                out.append(Shard(name, start, end))
+                continue
+            pos = start
+            while pos < end:
+                chunk_end = min(pos + self._records_per_task, end)
+                out.append(Shard(name, pos, chunk_end))
+                pos = chunk_end
+        return out
+
+    def _create_tasks(self, shards, task_type, model_version=-1):
+        pieces = self._split(shards)
+        if task_type == pb.TRAINING and self._shuffle_shards:
+            self._rng.shuffle(pieces)
+        if task_type == pb.TRAINING and self._shuffle:
+            for piece in pieces:
+                indices = list(range(piece.start, piece.end))
+                self._rng.shuffle(indices)
+                piece.record_indices = indices
+        tasks = []
+        for piece in pieces:
+            self._task_id += 1
+            tasks.append(Task(self._task_id, piece, task_type, model_version))
+        self._todo.extend(tasks)
+        return tasks
+
+    def _create_training_tasks(self):
+        self._create_tasks(self._training_shards, pb.TRAINING)
+
+    def create_evaluation_tasks(self, model_version):
+        """Version-triggered eval job (reference task_manager create_evaluation_tasks)."""
+        with self._lock:
+            tasks = self._create_tasks(
+                self._evaluation_shards, pb.EVALUATION, model_version
+            )
+            # Evaluation interleaves ahead of remaining training tasks.
+            for _ in tasks:
+                self._todo.rotate(1)
+            return len(tasks)
+
+    def set_train_end_callback_task(self):
+        self._train_end_callback_pending = True
+
+    # -- dispatch -----------------------------------------------------------
+
+    def get(self, worker_id):
+        """Pop the next task for a worker; None when nothing is available."""
+        with self._lock:
+            if not self._todo and not self._doing:
+                if self._epoch < self._num_epochs - 1 and self._training_shards:
+                    self._epoch += 1
+                    logger.info("starting epoch %d", self._epoch)
+                    self._create_training_tasks()
+                elif (
+                    self._train_end_callback_pending
+                    and not self._train_end_callback_done
+                    and self._finished_training_locked()
+                ):
+                    self._train_end_callback_done = True
+                    self._task_id += 1
+                    task = Task(
+                        self._task_id, Shard("", 0, 0), pb.TRAIN_END_CALLBACK
+                    )
+                    self._doing[task.id] = (worker_id, task, time.time())
+                    return task
+            if not self._todo:
+                return None
+            task = self._todo.popleft()
+            self._doing[task.id] = (worker_id, task, time.time())
+            return task
+
+    def report(self, task_id, success, err_message=""):
+        """Worker reports a task result; failed tasks are retried <=N times.
+
+        Returns a ReportResult.
+        """
+        with self._lock:
+            entry = self._doing.pop(task_id, None)
+            if entry is None:
+                logger.warning("report for unknown task %d", task_id)
+                return ReportResult(False, None, False)
+            worker_id, task, start_time = entry
+            if success:
+                elapsed = time.time() - start_time
+                self._max_task_completed_time = max(
+                    self._max_task_completed_time, elapsed
+                )
+                self.completed_counts[task.type] += 1
+                return ReportResult(True, task, False)
+            task.retry_count += 1
+            if task.retry_count <= self._max_task_retries:
+                logger.info(
+                    "task %d failed (%s), retry %d/%d",
+                    task_id, err_message, task.retry_count,
+                    self._max_task_retries,
+                )
+                self._todo.appendleft(task)
+                return ReportResult(False, task, False)
+            logger.error(
+                "task %d permanently failed: %s", task_id, err_message
+            )
+            self.failed_counts[task.type] += 1
+            return ReportResult(False, task, True)
+
+    def recover_tasks(self, worker_id):
+        """Re-queue every task a dead worker was holding (elasticity path)."""
+        with self._lock:
+            owned = [
+                tid for tid, (wid, _, _) in self._doing.items()
+                if wid == worker_id
+            ]
+        for tid in owned:
+            self.report(tid, False, err_message="worker %s died" % worker_id)
+
+    # -- progress -----------------------------------------------------------
+
+    def _finished_training_locked(self):
+        done_epochs = self._epoch >= self._num_epochs - 1
+        return done_epochs and not self._todo and not any(
+            t.type == pb.TRAINING for _, t, _ in self._doing.values()
+        )
+
+    def finished_training(self):
+        with self._lock:
+            return self._finished_training_locked()
+
+    def finished(self):
+        with self._lock:
+            more_epochs = (
+                self._training_shards and self._epoch < self._num_epochs - 1
+            )
+            pending_callback = (
+                self._train_end_callback_pending
+                and not self._train_end_callback_done
+            )
+            return (
+                not self._todo
+                and not self._doing
+                and not more_epochs
+                and not pending_callback
+            )
+
+    def counts(self):
+        with self._lock:
+            return {
+                "todo": len(self._todo),
+                "doing": len(self._doing),
+                "completed": dict(self.completed_counts),
+                "failed": dict(self.failed_counts),
+                "epoch": self._epoch,
+            }
+
+    # -- timeout watchdog ---------------------------------------------------
+
+    def add_worker_timeout_callback(self, fn):
+        """fn(worker_id) called when a worker times out on a task."""
+        self._worker_timeout_callbacks.append(fn)
+
+    def start(self):
+        self._watchdog = threading.Thread(
+            target=self._watch_timeouts, name="task-timeout-watchdog",
+            daemon=True,
+        )
+        self._watchdog.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _timeout_threshold(self):
+        return max(self._task_timeout_secs, 3 * self._max_task_completed_time)
+
+    def _watch_timeouts(self):
+        while not self._stopped.wait(timeout=5):
+            threshold = self._timeout_threshold()
+            now = time.time()
+            with self._lock:
+                timed_out = [
+                    (tid, wid) for tid, (wid, _, start) in self._doing.items()
+                    if now - start > threshold
+                ]
+            for tid, wid in timed_out:
+                logger.warning(
+                    "task %d timed out on worker %s; re-queueing", tid, wid
+                )
+                self.report(tid, False, err_message="timeout")
+                for fn in self._worker_timeout_callbacks:
+                    fn(wid)

@@ -1,0 +1,73 @@
+"""MNIST zoo model — the minimum end-to-end slice.
+
+Counterpart of the reference's model_zoo/mnist/mnist_functional_api.py:21-103
+(custom_model/loss/optimizer/feed/eval_metrics_fn contract), built as a
+small conv net in flax.linen with an optax optimizer.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.utils import metrics
+
+
+class MnistCNN(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], 28, 28, 1))
+        x = nn.Conv(32, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def feed(records):
+    xs = np.stack([np.asarray(r[0], dtype=np.float32) for r in records])
+    ys = np.asarray([int(r[1]) for r in records], dtype=np.int32)
+    return xs / 255.0 if xs.max() > 1.5 else xs, ys
+
+
+def model_spec(learning_rate=1e-3):
+    model = MnistCNN()
+
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def apply_fn(params, x, train):
+        return model.apply({"params": params}, x, train=train)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+
+    return ModelSpec(
+        name="mnist",
+        init_fn=init_fn,
+        apply_fn=apply_fn,
+        loss_fn=loss_fn,
+        optimizer=optax.adam(learning_rate),
+        feed=feed,
+        eval_metrics_fn=lambda: {"accuracy": metrics.Accuracy()},
+    )
+
+
+def synthetic_data(n=512, seed=0):
+    """Deterministic learnable synthetic digits for tests/benchmarks."""
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 10, size=n).astype(np.int32)
+    xs = rng.rand(n, 28, 28).astype(np.float32) * 0.1
+    for i in range(n):
+        digit = ys[i]
+        xs[i, 2 + digit : 6 + digit, 4:24] += 0.9  # class-dependent band
+    return xs, ys

@@ -1,0 +1,82 @@
+"""Hand-written gRPC service plumbing.
+
+The image has no ``grpc_tools`` protoc plugin, so instead of generated
+``*_pb2_grpc.py`` modules we declare each service's method table once and
+derive both the client stub and the server registration from it.  This plays
+the role of the generated service code in the reference
+(elasticai_api/proto/elasticai_api.proto:96-105,
+elasticdl/proto/elasticdl.proto:41-86).
+"""
+
+import grpc
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+
+# service name -> {method name: (request class, response class)}
+SERVICES = {
+    "elasticdl_tpu.Master": {
+        "get_task": (pb.GetTaskRequest, pb.GetTaskResponse),
+        "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
+        "report_batch_done": (pb.ReportBatchDoneRequest, pb.Empty),
+        "get_comm_rank": (pb.GetCommRankRequest, pb.GetCommRankResponse),
+        "report_train_loop_status": (pb.ReportTrainLoopStatusRequest, pb.Empty),
+        "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
+        "report_version": (pb.ReportVersionRequest, pb.Empty),
+        "report_training_params": (pb.ReportTrainingParamsRequest, pb.Empty),
+    },
+    "elasticdl_tpu.PServer": {
+        "push_model": (pb.ModelPB, pb.Empty),
+        "push_embedding_table_infos": (pb.ModelPB, pb.Empty),
+        "pull_dense_parameters": (
+            pb.PullDenseParametersRequest,
+            pb.PullDenseParametersResponse,
+        ),
+        "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.TensorPB),
+        "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+    },
+}
+
+
+def _make_stub_class(service_name):
+    methods = SERVICES[service_name]
+
+    class Stub:
+        def __init__(self, channel):
+            for name, (req_cls, res_cls) in methods.items():
+                setattr(
+                    self,
+                    name,
+                    channel.unary_unary(
+                        "/%s/%s" % (service_name, name),
+                        request_serializer=req_cls.SerializeToString,
+                        response_deserializer=res_cls.FromString,
+                    ),
+                )
+
+    Stub.__name__ = service_name.split(".")[-1] + "Stub"
+    return Stub
+
+
+MasterStub = _make_stub_class("elasticdl_tpu.Master")
+PServerStub = _make_stub_class("elasticdl_tpu.PServer")
+
+
+def _add_servicer(service_name, servicer, server):
+    handlers = {}
+    for name, (req_cls, res_cls) in SERVICES[service_name].items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=res_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
+    )
+
+
+def add_master_servicer(servicer, server):
+    _add_servicer("elasticdl_tpu.Master", servicer, server)
+
+
+def add_pserver_servicer(servicer, server):
+    _add_servicer("elasticdl_tpu.PServer", servicer, server)

@@ -1,0 +1,123 @@
+"""Round-trip argument system.
+
+Parity with the reference's three-tier flag system (SURVEY.md §5.6,
+elasticdl_client/common/args.py, elasticdl/python/common/args.py): the
+master re-serializes its parsed args into worker command lines, so every
+parser here supports ``build_arguments_from_parsed_result`` round-trips.
+"""
+
+import argparse
+
+
+def _str2bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("true", "1", "yes")
+
+
+def add_common_args(parser):
+    parser.add_argument("--job_name", default="elasticdl-tpu-job")
+    parser.add_argument("--model_zoo", default="mnist",
+                        help="zoo module name or dotted path")
+    parser.add_argument("--data_origin", default="synthetic_mnist",
+                        help="dataset spec: synthetic_mnist[:n], csv path, "
+                             "recio dir")
+    parser.add_argument("--validation_data_origin", default="")
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--num_minibatches_per_task", type=int, default=8)
+    parser.add_argument("--distribution_strategy", default="local",
+                        choices=["local", "collective", "ps"])
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--evaluation_steps", type=int, default=0)
+    parser.add_argument("--log_loss_steps", type=int, default=100)
+    parser.add_argument("--use_bf16", type=_str2bool, default=False)
+    parser.add_argument("--seed", type=int, default=0)
+
+
+def build_master_parser():
+    parser = argparse.ArgumentParser("elasticdl_tpu.master")
+    add_common_args(parser)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--num_workers", type=int, default=0,
+                        help="0 = workers launched externally")
+    parser.add_argument("--num_ps", type=int, default=0)
+    parser.add_argument("--shuffle", type=_str2bool, default=False)
+    parser.add_argument("--shuffle_shards", type=_str2bool, default=False)
+    parser.add_argument("--max_task_retries", type=int, default=3)
+    parser.add_argument("--task_timeout_secs", type=float, default=300)
+    parser.add_argument("--relaunch_on_worker_failure", type=int, default=3)
+    return parser
+
+
+def build_worker_parser():
+    parser = argparse.ArgumentParser("elasticdl_tpu.worker")
+    add_common_args(parser)
+    parser.add_argument("--master_addr", default="")
+    parser.add_argument("--worker_id", type=int, default=-1)
+    parser.add_argument("--ps_addrs", default="",
+                        help="comma-separated parameter server addresses")
+    return parser
+
+
+def build_ps_parser():
+    parser = argparse.ArgumentParser("elasticdl_tpu.ps")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--ps_id", type=int, default=0)
+    parser.add_argument("--num_ps", type=int, default=1)
+    parser.add_argument("--master_addr", default="")
+    parser.add_argument("--opt_type", default="sgd")
+    parser.add_argument("--opt_args", default="learning_rate=0.1",
+                        help="k=v;k=v optimizer arguments")
+    parser.add_argument("--use_async", type=_str2bool, default=True)
+    parser.add_argument("--grads_to_wait", type=int, default=1)
+    parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    parser.add_argument("--lr_staleness_modulation", type=_str2bool,
+                        default=False)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--evaluation_steps", type=int, default=0)
+    return parser
+
+
+def parse_master_args(argv=None):
+    return build_master_parser().parse_args(argv)
+
+
+def parse_worker_args(argv=None):
+    return build_worker_parser().parse_args(argv)
+
+
+def parse_ps_args(argv=None):
+    return build_ps_parser().parse_args(argv)
+
+
+def build_arguments_from_parsed_result(args, filter_args=(), defaults=None):
+    """Re-serialize a Namespace into a flag list (reference
+    elasticdl_client/api.py:128-139 round-trip pattern)."""
+    items = []
+    for key, value in sorted(vars(args).items()):
+        if key in filter_args or value is None:
+            continue
+        items.extend(["--" + key, str(value)])
+    return items
+
+
+def parse_opt_args(opt_args):
+    """Parse "k=v;k=v" optimizer argument strings (reference
+    go/pkg/ps/optimizer.go:304-326)."""
+    out = {}
+    for piece in opt_args.split(";"):
+        piece = piece.strip()
+        if not piece:
+            continue
+        key, _, value = piece.partition("=")
+        try:
+            out[key.strip()] = float(value)
+        except ValueError:
+            out[key.strip()] = value.strip()
+    return out

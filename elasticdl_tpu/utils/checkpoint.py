@@ -1,0 +1,169 @@
+"""Versioned, sharded checkpoints with validity checks and GC.
+
+Same directory scheme as the reference
+(elasticdl/python/common/save_utils.py:93-294, go/pkg/ps/checkpoint.go):
+
+    <dir>/version-<v>/variables-<i>-of-<N>.ckpt
+
+A version is valid iff its shard-file count matches the N parsed from any
+filename, so a reader can always tell a torn write from a complete one.
+Shard routing matches utils/hashing.py (dense by name hash, embeddings by
+id mod N) so any shard count can be re-read by any other shard count.
+Payload per shard is a numpy .npz (named dense arrays + per-table id/value
+pairs), not protobuf — zero-copy friendly on the JAX side.
+"""
+
+import os
+import re
+import shutil
+
+import numpy as np
+
+from elasticdl_tpu.utils import hashing
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SHARD_RE = re.compile(r"variables-(\d+)-of-(\d+)\.ckpt$")
+
+
+def _version_dir(root, version):
+    return os.path.join(root, "version-%d" % version)
+
+
+def _shard_file(root, version, i, n):
+    return os.path.join(
+        _version_dir(root, version), "variables-%d-of-%d.ckpt" % (i, n)
+    )
+
+
+class CheckpointSaver:
+    def __init__(self, checkpoint_dir, keep_max=3):
+        self._dir = checkpoint_dir
+        self._keep_max = keep_max
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    # -- write --------------------------------------------------------------
+
+    def save_shard(
+        self, version, shard_index, num_shards,
+        dense=None, embeddings=None,
+    ):
+        """Write one shard of one version.
+
+        dense: {name: ndarray} owned by this shard.
+        embeddings: {table_name: (ids ndarray, values ndarray)}.
+        """
+        os.makedirs(_version_dir(self._dir, version), exist_ok=True)
+        payload = {}
+        for name, arr in (dense or {}).items():
+            payload["dense/" + name] = np.asarray(arr)
+        for name, (ids, values) in (embeddings or {}).items():
+            payload["emb_ids/" + name] = np.asarray(ids, dtype=np.int64)
+            payload["emb_vals/" + name] = np.asarray(values)
+        path = _shard_file(self._dir, version, shard_index, num_shards)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+        if shard_index == 0:
+            self._gc()
+        return path
+
+    def save(self, version, dense=None, embeddings=None, num_shards=1):
+        """Single-writer convenience: hash-route everything across shards."""
+        for i in range(num_shards):
+            shard_dense = {
+                k: v for k, v in (dense or {}).items()
+                if hashing.string_to_id(k, num_shards) == i
+            }
+            shard_emb = {}
+            for name, (ids, values) in (embeddings or {}).items():
+                ids = np.asarray(ids, dtype=np.int64)
+                sel = ids % num_shards == i
+                shard_emb[name] = (ids[sel], np.asarray(values)[sel])
+            self.save_shard(
+                version, i, num_shards,
+                dense=shard_dense, embeddings=shard_emb,
+            )
+
+    # -- read ---------------------------------------------------------------
+
+    def versions(self):
+        out = []
+        if not os.path.isdir(self._dir):
+            return out
+        for entry in os.listdir(self._dir):
+            m = re.match(r"version-(\d+)$", entry)
+            if m and self.is_valid_version(int(m.group(1))):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_version(self):
+        versions = self.versions()
+        return versions[-1] if versions else None
+
+    def is_valid_version(self, version):
+        vdir = _version_dir(self._dir, version)
+        if not os.path.isdir(vdir):
+            return False
+        shard_counts = set()
+        files = 0
+        for entry in os.listdir(vdir):
+            m = _SHARD_RE.search(entry)
+            if m:
+                files += 1
+                shard_counts.add(int(m.group(2)))
+        return len(shard_counts) == 1 and files == shard_counts.pop()
+
+    def load(self, version=None):
+        """Load all shards of a version.
+
+        Returns (dense {name: ndarray}, embeddings {name: (ids, values)}).
+        """
+        if version is None:
+            version = self.latest_version()
+        if version is None:
+            raise FileNotFoundError("no valid checkpoint in %s" % self._dir)
+        vdir = _version_dir(self._dir, version)
+        dense = {}
+        embeddings = {}
+        for entry in sorted(os.listdir(vdir)):
+            if not _SHARD_RE.search(entry):
+                continue
+            with np.load(os.path.join(vdir, entry)) as z:
+                for key in z.files:
+                    kind, name = key.split("/", 1)
+                    if kind == "dense":
+                        dense[name] = z[key]
+                    elif kind == "emb_ids":
+                        ids = z[key]
+                        values = z["emb_vals/" + name]
+                        if name in embeddings:
+                            prev_ids, prev_vals = embeddings[name]
+                            ids = np.concatenate([prev_ids, ids])
+                            values = np.concatenate([prev_vals, values])
+                        embeddings[name] = (ids, values)
+        return dense, embeddings, version
+
+    def load_shard(self, version, shard_index, num_shards):
+        """Re-route a stored version onto shard_index of a new shard count."""
+        dense, embeddings, version = self.load(version)
+        my_dense = {
+            k: v for k, v in dense.items()
+            if hashing.string_to_id(k, num_shards) == shard_index
+        }
+        my_emb = {}
+        for name, (ids, values) in embeddings.items():
+            sel = ids % num_shards == shard_index
+            my_emb[name] = (ids[sel], values[sel])
+        return my_dense, my_emb, version
+
+    # -- gc -----------------------------------------------------------------
+
+    def _gc(self):
+        versions = self.versions()
+        while len(versions) > self._keep_max:
+            victim = versions.pop(0)
+            shutil.rmtree(_version_dir(self._dir, victim), ignore_errors=True)
+            logger.info("checkpoint GC: removed version-%d", victim)

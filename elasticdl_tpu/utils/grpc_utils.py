@@ -1,0 +1,41 @@
+"""gRPC channel/server builders with large-message options.
+
+256 MB caps mirror the reference (elasticai_api/common/constants.py:15-20,
+elasticdl/go/pkg/ps/server.go:31-34): a full dense pull of a ~90 MB model
+must fit in one message.
+"""
+
+import socket
+from concurrent import futures
+
+import grpc
+
+MAX_MESSAGE_BYTES = 256 * 1024 * 1024
+
+CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
+def build_channel(addr):
+    channel = grpc.insecure_channel(addr, options=CHANNEL_OPTIONS)
+    return channel
+
+
+def wait_for_channel_ready(channel, timeout=30):
+    grpc.channel_ready_future(channel).result(timeout=timeout)
+
+
+def build_server(max_workers=64):
+    return grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=CHANNEL_OPTIONS,
+    )
+
+
+def find_free_port(host="localhost"):
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]

@@ -1,0 +1,31 @@
+"""Stable partition routing.
+
+Dense parameters route to a PS shard by name hash; embedding ids route by
+``id % n``.  Same contract as the reference
+(elasticdl/python/common/hash_utils.py:17-62,
+elasticdl/go/pkg/ps/checkpoint.go:31-39) so checkpoints written by any shard
+count N can be re-routed deterministically.
+"""
+
+import hashlib
+
+
+def string_to_id(name, num_partitions):
+    h = hashlib.sha256(name.encode("utf-8")).hexdigest()
+    return int(h, 16) % num_partitions
+
+
+def int_to_id(value, num_partitions):
+    return int(value) % num_partitions
+
+
+def scatter_ids(ids, num_partitions):
+    """Group a sequence of embedding ids by owning partition.
+
+    Returns {partition: [positions]} so callers can gather results back into
+    the original order.
+    """
+    buckets = {}
+    for pos, value in enumerate(ids):
+        buckets.setdefault(int(value) % num_partitions, []).append(pos)
+    return buckets

@@ -1,0 +1,46 @@
+"""Pytree <-> flat named-dict bridges for checkpointing and PS exchange."""
+
+import jax
+import numpy as np
+
+
+def _key_name(key):
+    if isinstance(key, jax.tree_util.DictKey):
+        return str(key.key)
+    if isinstance(key, jax.tree_util.SequenceKey):
+        return str(key.idx)
+    if isinstance(key, jax.tree_util.GetAttrKey):
+        return str(key.name)
+    if isinstance(key, jax.tree_util.FlattenedIndexKey):
+        return str(key.key)
+    return str(key)
+
+
+def flatten_with_names(tree):
+    """Return ({dotted_name: leaf}, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    named = {}
+    for path, leaf in leaves:
+        name = "/".join(_key_name(k) for k in path) or "param"
+        named[name] = leaf
+    return named, treedef
+
+
+def unflatten_from_names(tree_like, named):
+    """Rebuild a pytree shaped like tree_like from {dotted_name: array}."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree_like)
+    new_leaves = []
+    for path, leaf in leaves:
+        name = "/".join(_key_name(k) for k in path) or "param"
+        if name not in named:
+            raise KeyError("missing parameter %s in restore data" % name)
+        new_leaves.append(
+            np.asarray(named[name]).reshape(np.shape(leaf)).astype(
+                np.asarray(leaf).dtype
+            )
+        )
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def to_numpy(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

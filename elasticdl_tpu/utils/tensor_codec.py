@@ -1,0 +1,106 @@
+"""ndarray <-> TensorPB codec and IndexedSlices helpers.
+
+Parity with elasticdl/python/common/tensor_utils.py:31-122, but
+self-describing (dtype/shape in the message, no TF TensorProto) and with
+first-class bfloat16 via ml_dtypes — the natural on-wire dtype for TPU
+gradients at half the bandwidth of float32.
+"""
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _EXTRA_DTYPES = {"bfloat16": np.dtype(ml_dtypes.bfloat16)}
+except ImportError:  # pragma: no cover
+    _EXTRA_DTYPES = {}
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+
+
+def _np_dtype(name):
+    if name in _EXTRA_DTYPES:
+        return _EXTRA_DTYPES[name]
+    return np.dtype(name)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name if np.dtype(dtype).name != "void" else str(dtype)
+
+
+def ndarray_to_pb(array, out=None):
+    array = np.ascontiguousarray(array)
+    t = out if out is not None else pb.TensorPB()
+    t.dtype = array.dtype.name
+    del t.dims[:]
+    t.dims.extend(array.shape)
+    t.content = array.tobytes()
+    return t
+
+
+def pb_to_ndarray(t):
+    dtype = _np_dtype(t.dtype)
+    array = np.frombuffer(t.content, dtype=dtype)
+    return array.reshape(tuple(t.dims))
+
+
+def indexed_slices_to_pb(values, ids, out=None):
+    s = out if out is not None else pb.IndexedSlicesPB()
+    ndarray_to_pb(values, out=s.values)
+    del s.ids[:]
+    s.ids.extend(int(i) for i in ids)
+    return s
+
+
+def pb_to_indexed_slices(s):
+    return pb_to_ndarray(s.values), np.asarray(s.ids, dtype=np.int64)
+
+
+def merge_indexed_slices(values, ids):
+    """Deduplicate ids, summing rows that share an id.
+
+    Equivalent of the reference's unsorted_segment_sum merge
+    (elasticdl/python/common/tensor_utils.py:44-56) done with numpy:
+    duplicate embedding ids inside one minibatch must contribute a single
+    summed gradient row before the PS push.
+    """
+    ids = np.asarray(ids, dtype=np.int64)
+    values = np.asarray(values)
+    uniq, inverse = np.unique(ids, return_inverse=True)
+    merged = np.zeros((uniq.shape[0],) + values.shape[1:], dtype=values.dtype)
+    np.add.at(merged, inverse, values)
+    return merged, uniq
+
+
+def model_to_pb(dense=None, embeddings=None, infos=None, version=0):
+    """Build a ModelPB from dicts of ndarrays / (values, ids) pairs."""
+    m = pb.ModelPB(version=version)
+    for name, arr in (dense or {}).items():
+        ndarray_to_pb(np.asarray(arr), out=m.dense_parameters[name])
+    for name, (values, ids) in (embeddings or {}).items():
+        indexed_slices_to_pb(values, ids, out=m.embedding_tables[name])
+    for info in infos or []:
+        m.embedding_table_infos.add(
+            name=info["name"],
+            dim=info["dim"],
+            initializer=info.get("initializer", "uniform"),
+            dtype=info.get("dtype", "float32"),
+        )
+    return m
+
+
+def pb_to_model(m):
+    dense = {k: pb_to_ndarray(v) for k, v in m.dense_parameters.items()}
+    embeddings = {
+        k: pb_to_indexed_slices(v) for k, v in m.embedding_tables.items()
+    }
+    infos = [
+        {
+            "name": i.name,
+            "dim": i.dim,
+            "initializer": i.initializer,
+            "dtype": i.dtype,
+        }
+        for i in m.embedding_table_infos
+    ]
+    return dense, embeddings, infos, m.version

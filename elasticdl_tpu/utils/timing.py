@@ -1,0 +1,75 @@
+"""Per-phase timing accumulators.
+
+Built-in observability from day one (SURVEY.md §5.1): the reference only has
+a DEBUG-level Timing helper (elasticdl/python/common/timing_utils.py:17-48);
+here timing is always on, cheap, and reportable, and integrates with the JAX
+profiler for device traces.
+"""
+
+import contextlib
+import time
+from collections import defaultdict
+
+
+class Timing:
+    """Accumulates wall-clock per named phase across calls."""
+
+    def __init__(self, enabled=True, logger=None):
+        self._enabled = enabled
+        self._logger = logger
+        self.reset()
+
+    def reset(self):
+        self._totals = defaultdict(float)
+        self._counts = defaultdict(int)
+        self._starts = {}
+
+    def start(self, name):
+        if self._enabled:
+            self._starts[name] = time.perf_counter()
+
+    def end(self, name):
+        if self._enabled and name in self._starts:
+            self._totals[name] += time.perf_counter() - self._starts.pop(name)
+            self._counts[name] += 1
+
+    @contextlib.contextmanager
+    def timeit(self, name):
+        self.start(name)
+        try:
+            yield
+        finally:
+            self.end(name)
+
+    def summary(self):
+        return {
+            name: {
+                "total_s": self._totals[name],
+                "count": self._counts[name],
+                "mean_s": self._totals[name] / max(1, self._counts[name]),
+            }
+            for name in self._totals
+        }
+
+    def report(self):
+        if self._logger is not None:
+            for name, s in sorted(self.summary().items()):
+                self._logger.info(
+                    "timing[%s]: total=%.3fs count=%d mean=%.4fs",
+                    name,
+                    s["total_s"],
+                    s["count"],
+                    s["mean_s"],
+                )
+
+
+@contextlib.contextmanager
+def device_trace(log_dir):
+    """Capture an XLA/JAX profiler trace around a block (xplane format)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

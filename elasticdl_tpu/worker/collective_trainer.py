@@ -1,0 +1,314 @@
+"""Collective data-parallel trainer — the TPU-native AllReduce path.
+
+Replaces the reference's Horovod/Gloo AllReduce trainer
+(elasticdl/python/worker/allreduce_trainer.py:37-146) with a jitted train
+step over a ``jax.sharding.Mesh``: the batch is sharded on the ``data`` axis,
+parameters are replicated, and XLA inserts the gradient all-reduce over ICI.
+Fixed-global-batch elasticity (reference
+elasticai_api/pytorch/optimizer.py:136-169) becomes a ``lax.scan`` gradient
+accumulation over microbatches, re-jitted when the accumulation count
+changes with the world size.  Rebuilding for a new mesh = re-sharding params
+and re-jitting — the compile cache keyed by (mesh shape, accum steps).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
+from elasticdl_tpu.utils.timing import Timing
+from elasticdl_tpu.worker.trainer import Trainer
+
+logger = get_logger(__name__)
+
+
+def _masked_mean(per_example, weights):
+    per_example = per_example.reshape(per_example.shape[0], -1).mean(axis=-1)
+    return jnp.sum(per_example * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def _pad_batch(tree, batch_size):
+    """Pad every leaf to batch_size rows; returns (padded, weights)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    n = leaves[0].shape[0]
+    if n > batch_size:
+        raise ValueError(
+            "minibatch has %d records > trainer's global batch %d"
+            % (n, batch_size)
+        )
+    weights = np.zeros((batch_size,), dtype=np.float32)
+    weights[:n] = 1.0
+    if n == batch_size:
+        return tree, weights
+
+    def pad(a):
+        a = np.asarray(a)
+        pad_width = [(0, batch_size - n)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, pad_width)
+
+    return jax.tree_util.tree_map(pad, tree), weights
+
+
+class CollectiveTrainer(Trainer):
+    def __init__(
+        self,
+        spec,
+        batch_size,
+        mesh=None,
+        data_axis="data",
+        accum_steps=1,
+        rng_seed=0,
+        master_client=None,
+        report_version_steps=0,
+        checkpoint_saver=None,
+        checkpoint_steps=0,
+        use_bf16_compute=False,
+    ):
+        self._spec = spec
+        self._batch_size = batch_size
+        self._data_axis = data_axis
+        self._accum_steps = accum_steps
+        self._mc = master_client
+        self._report_version_steps = report_version_steps
+        self._checkpoint_saver = checkpoint_saver
+        self._checkpoint_steps = checkpoint_steps
+        self._use_bf16_compute = use_bf16_compute
+        self.timing = Timing(logger=logger)
+        self._version = 0
+
+        params = spec.init_fn(jax.random.PRNGKey(rng_seed))
+        self._opt_state = spec.optimizer.init(params)
+        self._params = params
+        self._mesh = None
+        self.rebuild(mesh)
+
+    # -- mesh / jit management ---------------------------------------------
+
+    def rebuild(self, mesh):
+        """(Re)shard state and (re)compile steps for a (new) mesh.
+
+        This is the elastic-resize path: called at init and whenever the
+        rendezvous epoch changes the device world.
+        """
+        self._mesh = mesh
+        if mesh is not None:
+            replicated = NamedSharding(mesh, P())
+            self._batch_sharding = NamedSharding(mesh, P(self._data_axis))
+            self._params = jax.device_put(to_numpy(self._params), replicated)
+            self._opt_state = jax.device_put(
+                to_numpy(self._opt_state), replicated
+            )
+            self._replicated = replicated
+        else:
+            self._batch_sharding = None
+            self._replicated = None
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+
+    @property
+    def global_device_count(self):
+        return self._mesh.size if self._mesh is not None else 1
+
+    def set_accum_steps(self, accum_steps):
+        if accum_steps != self._accum_steps:
+            self._accum_steps = accum_steps
+            self._train_step = self._build_train_step()
+
+    def _loss_and_grads(self, params, features, labels, weights):
+        apply_fn = self._spec.apply_fn
+        loss_fn = self._spec.loss_fn
+
+        def f(p):
+            if self._use_bf16_compute:
+                p = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                    p,
+                )
+            out = apply_fn(p, features, True)
+            per_example = loss_fn(out, labels).astype(jnp.float32)
+            return _masked_mean(per_example, weights)
+
+        return jax.value_and_grad(f)(params)
+
+    def _build_train_step(self):
+        tx = self._spec.optimizer
+        accum = self._accum_steps
+
+        def step(params, opt_state, features, labels, weights):
+            if accum == 1:
+                loss, grads = self._loss_and_grads(
+                    params, features, labels, weights
+                )
+            else:
+                def body(carry, microbatch):
+                    acc_grads, acc_loss = carry
+                    f, l, w = microbatch
+                    loss, grads = self._loss_and_grads(params, f, l, w)
+                    acc_grads = jax.tree_util.tree_map(
+                        jnp.add, acc_grads, grads
+                    )
+                    return (acc_grads, acc_loss + loss), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    body, (zeros, 0.0), (features, labels, weights)
+                )
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss_sum / accum
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        if self._mesh is None:
+            return jax.jit(step, donate_argnums=(0, 1))
+        rep = self._replicated
+        if self._accum_steps == 1:
+            batch_in = self._batch_sharding
+        else:
+            # [accum, micro, ...]: shard the microbatch axis.
+            batch_in = NamedSharding(
+                self._mesh, P(None, self._data_axis)
+            )
+        weights_in = (
+            self._batch_sharding if self._accum_steps == 1
+            else NamedSharding(self._mesh, P(None, self._data_axis))
+        )
+        return jax.jit(
+            step,
+            in_shardings=(rep, rep, batch_in, batch_in, weights_in),
+            out_shardings=(rep, rep, rep),
+            donate_argnums=(0, 1),
+        )
+
+    def _build_eval_step(self):
+        apply_fn = self._spec.apply_fn
+
+        def step(params, features):
+            return apply_fn(params, features, False)
+
+        if self._mesh is None:
+            return jax.jit(step)
+        return jax.jit(
+            step,
+            in_shardings=(self._replicated, self._batch_sharding),
+            out_shardings=self._replicated,
+        )
+
+    # -- Trainer API --------------------------------------------------------
+
+    def _padded(self, features, labels, total):
+        (features, labels), weights = _pad_batch((features, labels), total)
+        return features, labels, weights
+
+    def train_minibatch(self, features, labels):
+        with self.timing.timeit("batch_process"):
+            if self._accum_steps == 1:
+                total = self._batch_size * self.global_device_count
+                features, labels, weights = self._padded(
+                    features, labels, total
+                )
+            else:
+                micro = self._batch_size * self.global_device_count
+                total = micro * self._accum_steps
+                features, labels, weights = self._padded(
+                    features, labels, total
+                )
+                reshape = lambda a: np.asarray(a).reshape(
+                    (self._accum_steps, micro) + np.asarray(a).shape[1:]
+                )
+                features = jax.tree_util.tree_map(reshape, features)
+                labels = jax.tree_util.tree_map(reshape, labels)
+                weights = weights.reshape(self._accum_steps, micro)
+            self._params, self._opt_state, loss = self._train_step(
+                self._params, self._opt_state, features, labels, weights
+            )
+        self._version += 1
+        self._maybe_report_and_checkpoint()
+        return float(loss), self._version
+
+    def _maybe_report_and_checkpoint(self):
+        if (
+            self._mc is not None
+            and self._report_version_steps
+            and self._version % self._report_version_steps == 0
+        ):
+            self._mc.report_version(self._version)
+        if (
+            self._checkpoint_saver is not None
+            and self._checkpoint_steps
+            and self._version % self._checkpoint_steps == 0
+        ):
+            self.save_checkpoint()
+
+    def evaluate_minibatch(self, features, labels):
+        n = jax.tree_util.tree_leaves(features)[0].shape[0]
+        total = self._batch_size * self.global_device_count
+        features, _, _ = self._padded(features, labels, total)
+        outputs = self._eval_step(self._params, features)
+        outputs = np.asarray(outputs)[:n]
+        return outputs, np.asarray(labels)
+
+    def predict_minibatch(self, features):
+        n = jax.tree_util.tree_leaves(features)[0].shape[0]
+        total = self._batch_size * self.global_device_count
+        leaves = jax.tree_util.tree_leaves(features)
+        weights = None
+        if leaves[0].shape[0] != total:
+            features, weights = _pad_batch(features, total)
+        outputs = self._eval_step(self._params, features)
+        return np.asarray(outputs)[:n]
+
+    # -- state --------------------------------------------------------------
+
+    @property
+    def version(self):
+        return self._version
+
+    @property
+    def params(self):
+        return self._params
+
+    def set_params(self, params):
+        self._params = params
+        self._opt_state = self._spec.optimizer.init(params)
+        if self._mesh is not None:
+            self._params = jax.device_put(
+                to_numpy(self._params), self._replicated
+            )
+            self._opt_state = jax.device_put(
+                to_numpy(self._opt_state), self._replicated
+            )
+
+    def export_parameters(self):
+        named, _ = flatten_with_names(to_numpy(self._params))
+        return named
+
+    def save_checkpoint(self):
+        with self.timing.timeit("checkpoint_save"):
+            self._checkpoint_saver.save(
+                self._version, dense=self.export_parameters()
+            )
+        logger.info("saved checkpoint at version %d", self._version)
+
+    def init_from_checkpoint(self):
+        if self._checkpoint_saver is None:
+            return False
+        try:
+            dense, _, version = self._checkpoint_saver.load()
+        except FileNotFoundError:
+            return False
+        from elasticdl_tpu.utils.pytree import unflatten_from_names
+
+        self._params = unflatten_from_names(to_numpy(self._params), dense)
+        self._opt_state = self._spec.optimizer.init(self._params)
+        if self._mesh is not None:
+            self.rebuild(self._mesh)
+        self._version = version
+        logger.info("restored checkpoint version %d", version)
+        return True

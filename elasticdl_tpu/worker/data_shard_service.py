@@ -1,0 +1,87 @@
+"""Client-side dynamic sharding protocol.
+
+Parity with elasticai_api/common/data_shard_service.py:46-212: fetch tasks
+from the master, count locally-consumed records, and automatically report a
+task done once its shard is fully consumed, so user training loops only call
+``fetch_shard``/``report_batch_done``.
+"""
+
+import threading
+import time
+from collections import deque
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+
+
+class LocalTask:
+    def __init__(self, task_pb):
+        self.id = task_pb.id
+        self.type = task_pb.type
+        self.shard = task_pb.shard
+        self.size = task_pb.shard.end - task_pb.shard.start
+        self.model_version = task_pb.model_version
+
+
+class DataShardService:
+    def __init__(self, master_client, batch_size=1, wait_poll_secs=0.5):
+        self._mc = master_client
+        self._batch_size = batch_size
+        self._wait_poll_secs = wait_poll_secs
+        self._lock = threading.Lock()
+        self._pending = deque()   # tasks whose records are being consumed
+        self._record_count = 0
+        self.exec_counters = {"batch_count": 0, "record_count": 0}
+
+    def fetch_task(self, task_type=None, wait=True):
+        """Fetch the next task; blocks through WAIT tasks if wait=True.
+
+        Returns None when the master says the job is finished.
+        """
+        while True:
+            task_pb = self._mc.get_task(task_type)
+            if task_pb.id < 0:
+                if task_pb.type == pb.WAIT and wait:
+                    time.sleep(self._wait_poll_secs)
+                    continue
+                return None
+            task = LocalTask(task_pb)
+            if task.type == pb.TRAINING:
+                # Only training tasks auto-complete via record counting;
+                # eval/predict/callback tasks are reported explicitly.
+                with self._lock:
+                    self._pending.append(task)
+            return task
+
+    def report_batch_done(self, batch_size=None):
+        """Count consumed records; auto-complete tasks as shards drain."""
+        count = batch_size or self._batch_size
+        self._mc.report_batch_done(count)
+        with self._lock:
+            self._record_count += count
+            self.exec_counters["batch_count"] += 1
+            self.exec_counters["record_count"] += count
+            while self._pending and self._record_count >= self._pending[0].size:
+                task = self._pending.popleft()
+                self._record_count -= task.size
+                self._mc.report_task_result(
+                    task.id, exec_counters=self.exec_counters
+                )
+
+    def report_task_failed(self, task, err_message):
+        with self._lock:
+            try:
+                self._pending.remove(task)
+                # Drop records consumed from the abandoned task so they
+                # don't count toward the next task's completion.
+                self._record_count = 0
+            except ValueError:
+                pass
+        self._mc.report_task_result(task.id, err_message=err_message)
+
+    def report_task_done(self, task):
+        with self._lock:
+            try:
+                self._pending.remove(task)
+            except ValueError:
+                pass
+        self._mc.report_task_result(task.id, exec_counters=self.exec_counters)

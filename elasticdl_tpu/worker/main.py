@@ -1,0 +1,101 @@
+"""Worker entrypoint (parity: elasticdl/python/worker/main.py:26-62).
+
+Identity and topology arrive via env (``MASTER_ADDR``, ``WORKER_ID``) with
+flag overrides; the model comes from the zoo contract by module name.
+"""
+
+import os
+
+if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+    # The session sitecustomize may have force-selected a TPU backend via
+    # jax.config (overriding JAX_PLATFORMS); honor an explicit platform
+    # request before any backend is initialized.  Process-backend drills
+    # set this to "cpu" so N workers can share one host.
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+    )
+
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.utils.args import parse_worker_args
+from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+logger = get_logger(__name__)
+
+
+def build_worker(args):
+    master_addr = args.master_addr or os.environ.get("MASTER_ADDR", "")
+    worker_id = (
+        args.worker_id if args.worker_id >= 0
+        else int(os.environ.get("WORKER_ID", 0))
+    )
+    channel = grpc_utils.build_channel(master_addr)
+    grpc_utils.wait_for_channel_ready(channel)
+    mc = MasterClient(channel, worker_id=worker_id)
+
+    spec = load_model_spec(args.model_zoo)
+    records_per_task = args.batch_size * args.num_minibatches_per_task
+    reader = create_data_reader(
+        args.data_origin, records_per_shard=records_per_task
+    )
+    saver = None
+    if args.checkpoint_dir and worker_id == 0:
+        # Only one writer: checkpoints are saved by worker 0 (the
+        # collective path replicates params, so any single worker's copy
+        # is the model).
+        saver = CheckpointSaver(
+            args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+        )
+    mesh = None
+    if args.distribution_strategy == "collective":
+        # Shard the batch over every device this process sees (a TPU
+        # worker VM sees its slice's local chips); XLA inserts the
+        # gradient all-reduce over ICI.  Multi-host worlds additionally
+        # join the master rendezvous (join_rendezvous below) and
+        # re-initialize on membership epochs via the elastic controller.
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+    trainer = CollectiveTrainer(
+        spec,
+        batch_size=args.batch_size,
+        mesh=mesh,
+        master_client=mc,
+        report_version_steps=max(1, args.evaluation_steps // 4)
+        if args.evaluation_steps else 0,
+        checkpoint_saver=saver,
+        checkpoint_steps=args.checkpoint_steps,
+        use_bf16_compute=args.use_bf16,
+        rng_seed=args.seed,
+    )
+    if saver is not None:
+        trainer.init_from_checkpoint()
+    worker = Worker(
+        mc, reader, spec, trainer,
+        batch_size=args.batch_size,
+        log_loss_steps=args.log_loss_steps,
+        join_rendezvous=args.distribution_strategy == "collective",
+    )
+    return worker
+
+
+def main(argv=None):
+    args = parse_worker_args(argv)
+    logger.info("worker starting: %s", vars(args))
+    worker = build_worker(args)
+    worker.run()
+    logger.info("worker done")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,84 @@
+"""Worker-side client to the master control plane.
+
+Parity with elasticai_api/common/master_client.py:20-131: thin typed
+wrappers over the gRPC stub, constructed from env
+(``MASTER_ADDR``/``WORKER_ID``) or explicitly.
+"""
+
+import os
+
+import numpy as np
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.proto.rpc import MasterStub
+from elasticdl_tpu.utils import grpc_utils, tensor_codec
+
+
+class MasterClient:
+    def __init__(self, channel, worker_id=0, worker_host=None):
+        self._stub = MasterStub(channel)
+        self.worker_id = worker_id
+        self.worker_host = worker_host or "worker-%d" % worker_id
+
+    @classmethod
+    def from_env(cls):
+        addr = os.environ["MASTER_ADDR"]
+        worker_id = int(os.environ.get("WORKER_ID", 0))
+        channel = grpc_utils.build_channel(addr)
+        grpc_utils.wait_for_channel_ready(channel)
+        return cls(channel, worker_id=worker_id)
+
+    def get_task(self, task_type=None):
+        req = pb.GetTaskRequest(worker_id=self.worker_id)
+        if task_type is not None:
+            req.task_type = task_type
+        return self._stub.get_task(req).task
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        req = pb.ReportTaskResultRequest(
+            task_id=task_id, err_message=err_message
+        )
+        for k, v in (exec_counters or {}).items():
+            req.exec_counters[k] = int(v)
+        self._stub.report_task_result(req)
+
+    def report_batch_done(self, record_count):
+        self._stub.report_batch_done(
+            pb.ReportBatchDoneRequest(
+                worker_id=self.worker_id, record_count=record_count
+            )
+        )
+
+    def get_comm_rank(self):
+        return self._stub.get_comm_rank(
+            pb.GetCommRankRequest(worker_host=self.worker_host)
+        )
+
+    def report_train_loop_status(self, status):
+        self._stub.report_train_loop_status(
+            pb.ReportTrainLoopStatusRequest(
+                worker_host=self.worker_host, status=status
+            )
+        )
+
+    def report_evaluation_metrics(self, model_outputs, labels):
+        req = pb.ReportEvaluationMetricsRequest(worker_id=self.worker_id)
+        if isinstance(model_outputs, dict):
+            for name, arr in model_outputs.items():
+                tensor_codec.ndarray_to_pb(
+                    np.asarray(arr), out=req.model_outputs[name]
+                )
+        else:
+            tensor_codec.ndarray_to_pb(
+                np.asarray(model_outputs), out=req.model_outputs["output"]
+            )
+        tensor_codec.ndarray_to_pb(np.asarray(labels), out=req.labels)
+        self._stub.report_evaluation_metrics(req)
+
+    def report_version(self, version):
+        self._stub.report_version(pb.ReportVersionRequest(model_version=version))
+
+    def report_training_params(self, **kwargs):
+        self._stub.report_training_params(
+            pb.ReportTrainingParamsRequest(**kwargs)
+        )

@@ -1,0 +1,24 @@
+"""Trainer interface (parity: elasticdl/python/worker/trainer.py:17-56)."""
+
+import abc
+
+
+class Trainer(abc.ABC):
+    @abc.abstractmethod
+    def train_minibatch(self, features, labels):
+        """Run one training step; returns (loss: float, version: int)."""
+
+    @abc.abstractmethod
+    def evaluate_minibatch(self, features, labels):
+        """Forward pass; returns (outputs ndarray, labels ndarray)."""
+
+    @abc.abstractmethod
+    def predict_minibatch(self, features):
+        """Forward pass; returns outputs ndarray."""
+
+    def init_from_checkpoint(self):
+        return False
+
+    def export_parameters(self):
+        """Return {name: ndarray} of the current model parameters."""
+        raise NotImplementedError

@@ -1,0 +1,146 @@
+"""Worker task loop.
+
+Parity with elasticdl/python/worker/worker.py:46-449: fetch task -> stream
+records -> train/evaluate/predict minibatches; a failing minibatch retries
+up to 64 times (reference DEFAULT_MAX_MINIBATCH_RETRY_NUM, worker.py:39);
+evaluation results go to the master's evaluation service; the train-end
+callback task runs model-export callbacks on exactly one worker.
+"""
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.timing import Timing
+from elasticdl_tpu.worker.data_shard_service import DataShardService
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+logger = get_logger(__name__)
+
+DEFAULT_MAX_MINIBATCH_RETRY_NUM = 64
+
+
+class Worker:
+    def __init__(
+        self,
+        master_client,
+        data_reader,
+        spec,
+        trainer,
+        batch_size,
+        max_minibatch_retries=DEFAULT_MAX_MINIBATCH_RETRY_NUM,
+        log_loss_steps=100,
+        join_rendezvous=False,
+    ):
+        self._mc = master_client
+        self._spec = spec
+        self._trainer = trainer
+        self._batch_size = batch_size
+        self._max_minibatch_retries = max_minibatch_retries
+        self._log_loss_steps = log_loss_steps
+        self._join_rendezvous = join_rendezvous
+        self._shard_service = DataShardService(master_client, batch_size)
+        self._data_service = TaskDataService(data_reader, spec.feed)
+        self.timing = Timing(logger=logger)
+        self._steps = 0
+
+    # -- task handlers ------------------------------------------------------
+
+    def _process_minibatch(self, features, labels):
+        err = None
+        for attempt in range(self._max_minibatch_retries):
+            try:
+                loss, version = self._trainer.train_minibatch(
+                    features, labels
+                )
+                self._steps += 1
+                if self._steps % self._log_loss_steps == 0:
+                    logger.info(
+                        "step %d loss %.6f (version %d)",
+                        self._steps, loss, version,
+                    )
+                return loss
+            except Exception as e:  # noqa: BLE001 — retry then surface
+                err = e
+                logger.warning(
+                    "minibatch failed (attempt %d): %s", attempt + 1, e
+                )
+        raise RuntimeError(
+            "minibatch failed after %d retries" % self._max_minibatch_retries
+        ) from err
+
+    def _train_task(self, task):
+        with self.timing.timeit("task_process"):
+            try:
+                for features, labels, count in (
+                    self._data_service.batch_stream(task, self._batch_size)
+                ):
+                    self._process_minibatch(features, labels)
+                    self._shard_service.report_batch_done(count)
+            except Exception as e:  # noqa: BLE001
+                # Report the failure so the master can retry the task on
+                # another worker; keep this worker alive for the next task.
+                logger.error("training task %d failed: %s", task.id, e)
+                self._shard_service.report_task_failed(task, str(e))
+
+    def _evaluate_task(self, task):
+        try:
+            for features, labels, _ in self._data_service.batch_stream(
+                task, self._batch_size
+            ):
+                outputs, labels = self._trainer.evaluate_minibatch(
+                    features, labels
+                )
+                self._mc.report_evaluation_metrics(outputs, labels)
+            self._shard_service.report_task_done(task)
+        except Exception as e:  # noqa: BLE001
+            self._shard_service.report_task_failed(task, str(e))
+            raise
+
+    def _predict_task(self, task):
+        processor = self._spec.prediction_outputs_processor
+        try:
+            for features, _labels, _ in self._data_service.batch_stream(
+                task, self._batch_size
+            ):
+                outputs = self._trainer.predict_minibatch(features)
+                if processor is not None:
+                    processor.process(outputs, self._mc.worker_id)
+            self._shard_service.report_task_done(task)
+        except Exception as e:  # noqa: BLE001
+            self._shard_service.report_task_failed(task, str(e))
+            raise
+
+    def _train_end_task(self, task):
+        try:
+            for callback in self._spec.callbacks:
+                if hasattr(callback, "on_train_end"):
+                    callback.on_train_end(self._trainer)
+            self._shard_service.report_task_done(task)
+        except Exception as e:  # noqa: BLE001
+            self._shard_service.report_task_failed(task, str(e))
+            raise
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self):
+        if self._join_rendezvous:
+            self._mc.report_train_loop_status(pb.LOOP_START)
+        try:
+            while True:
+                task = self._shard_service.fetch_task()
+                if task is None:
+                    break
+                if task.type == pb.TRAINING:
+                    self._train_task(task)
+                elif task.type == pb.EVALUATION:
+                    self._evaluate_task(task)
+                elif task.type == pb.PREDICTION:
+                    self._predict_task(task)
+                elif task.type == pb.TRAIN_END_CALLBACK:
+                    self._train_end_task(task)
+                else:
+                    logger.warning("unknown task type %s", task.type)
+                    self._shard_service.report_task_done(task)
+        finally:
+            if self._join_rendezvous:
+                self._mc.report_train_loop_status(pb.LOOP_END)
+            self.timing.report()

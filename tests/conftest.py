@@ -1,0 +1,21 @@
+"""Test env: force an 8-device virtual CPU platform.
+
+Mirrors the driver's multi-chip dry-run environment so every sharding test
+exercises a real (virtual) device mesh.  The session's sitecustomize
+registers the axon TPU backend and sets jax_platforms via jax.config, so
+overriding the env var alone is not enough — the config value must be
+updated before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,56 @@
+import os
+
+import numpy as np
+
+from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+
+def test_save_load_roundtrip(tmp_path):
+    saver = CheckpointSaver(str(tmp_path))
+    dense = {"a/w": np.random.rand(3, 3).astype(np.float32),
+             "b/w": np.random.rand(2,).astype(np.float32)}
+    emb = {"t": (np.array([1, 5, 9]), np.random.rand(3, 4).astype(np.float32))}
+    saver.save(10, dense=dense, embeddings=emb, num_shards=3)
+    d2, e2, v = saver.load()
+    assert v == 10
+    for k in dense:
+        np.testing.assert_array_equal(d2[k], dense[k])
+    ids, vals = e2["t"]
+    order = np.argsort(ids)
+    np.testing.assert_array_equal(ids[order], [1, 5, 9])
+
+
+def test_validity_check_rejects_torn_writes(tmp_path):
+    saver = CheckpointSaver(str(tmp_path))
+    saver.save(1, dense={"w": np.zeros(2)}, num_shards=2)
+    assert saver.is_valid_version(1)
+    os.remove(os.path.join(str(tmp_path), "version-1",
+                           "variables-1-of-2.ckpt"))
+    assert not saver.is_valid_version(1)
+    assert saver.versions() == []
+
+
+def test_gc_keeps_max_versions(tmp_path):
+    saver = CheckpointSaver(str(tmp_path), keep_max=2)
+    for v in range(5):
+        saver.save(v, dense={"w": np.full(2, v, np.float32)})
+    assert saver.versions() == [3, 4]
+    _, _, latest = saver.load()
+    assert latest == 4
+
+
+def test_reroute_shard_counts(tmp_path):
+    saver = CheckpointSaver(str(tmp_path))
+    dense = {"p%d" % i: np.full(2, i, np.float32) for i in range(8)}
+    emb = {"t": (np.arange(10), np.arange(40).reshape(10, 4).astype(
+        np.float32))}
+    saver.save(0, dense=dense, embeddings=emb, num_shards=4)
+    # Re-read as if we now run 3 PS shards.
+    all_dense = {}
+    all_ids = []
+    for i in range(3):
+        d, e, _ = saver.load_shard(0, i, 3)
+        all_dense.update(d)
+        all_ids.extend(e["t"][0].tolist())
+    assert set(all_dense) == set(dense)
+    assert sorted(all_ids) == list(range(10))

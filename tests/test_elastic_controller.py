@@ -1,0 +1,102 @@
+"""Elastic controller: epoch-change handling, accum math, retry loop."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.controller import (
+    ElasticCollectiveController,
+    compute_accum_steps,
+)
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from tests.test_utils import create_master, create_master_client
+
+
+def test_compute_accum_steps_fixed_global_batch():
+    # 8 microbatches globally over 3 workers: ranks 0,1 get 3, rank 2 gets 2
+    assert compute_accum_steps(8, 0, 3) == 3
+    assert compute_accum_steps(8, 1, 3) == 3
+    assert compute_accum_steps(8, 2, 3) == 2
+    assert compute_accum_steps(8, 0, 8) == 1
+    assert compute_accum_steps(2, 5, 8) == 1  # never below 1
+
+
+class FakeTrainer:
+    def __init__(self):
+        self.rebuilds = []
+        self.accum = None
+
+    def rebuild(self, mesh):
+        self.rebuilds.append(mesh)
+
+    def set_accum_steps(self, n):
+        self.accum = n
+
+
+def test_controller_reinits_on_epoch_change():
+    master = create_master(
+        training_shards=[("f", 0, 8)], records_per_task=8, rendezvous=True
+    )
+    try:
+        mc = create_master_client(master, worker_id=0)
+        trainer = FakeTrainer()
+        controller = ElasticCollectiveController(
+            mc, trainer, global_batch_num=8, check_secs=0.0,
+            mesh_builder=lambda rank, world, coord: ("mesh", world),
+        )
+        calls = []
+
+        @controller.elastic_run
+        def step(x):
+            calls.append(x)
+            return x * 2
+
+        with controller.scope():
+            import time
+            time.sleep(0.15)  # rendezvous grace
+            assert step(1) == 2
+            assert trainer.accum == 8  # world of 1 -> all microbatches local
+            assert trainer.rebuilds == [("mesh", 1)]
+
+            # second worker joins -> epoch bump -> rebuild with world=2
+            mc2 = create_master_client(master, worker_id=1)
+            mc2.report_train_loop_status(pb.LOOP_START)
+            time.sleep(0.15)
+            assert step(2) == 4
+            assert trainer.rebuilds[-1] == ("mesh", 2)
+            assert trainer.accum == 4
+    finally:
+        master.stop()
+
+
+def test_controller_retries_on_step_failure():
+    master = create_master(
+        training_shards=[("f", 0, 8)], records_per_task=8, rendezvous=True
+    )
+    try:
+        mc = create_master_client(master, worker_id=0)
+        trainer = FakeTrainer()
+        controller = ElasticCollectiveController(
+            mc, trainer, global_batch_num=1, check_secs=0.0
+        )
+        state = {"fails": 2}
+
+        @controller.elastic_run
+        def flaky():
+            if state["fails"] > 0:
+                state["fails"] -= 1
+                raise RuntimeError("collective timeout")
+            return "ok"
+
+        with controller.scope():
+            import time
+            time.sleep(0.15)
+            assert flaky() == "ok"
+
+        @controller.elastic_run
+        def always_fails():
+            raise RuntimeError("dead link")
+
+        with pytest.raises(RuntimeError, match="re-rendezvous retries"):
+            always_fails()
+    finally:
+        master.stop()

@@ -1,0 +1,90 @@
+"""Master gRPC service over a real in-process server."""
+
+import time
+
+import numpy as np
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import metrics
+from tests.test_utils import create_master, create_master_client
+
+
+def test_task_dispatch_and_report():
+    master = create_master(
+        training_shards=[("f", 0, 64)], records_per_task=32
+    )
+    try:
+        mc = create_master_client(master)
+        t1 = mc.get_task()
+        assert t1.id > 0 and t1.type == pb.TRAINING
+        t2 = mc.get_task()
+        # queue drained: worker gets a WAIT task while t1/t2 are in doing
+        t3 = mc.get_task()
+        assert t3.id == -1 and t3.type == pb.WAIT
+        mc.report_task_result(t1.id)
+        mc.report_task_result(t2.id)
+        t4 = mc.get_task()
+        assert t4.id == -1 and t4.type != pb.WAIT  # job finished
+    finally:
+        master.stop()
+
+
+def test_comm_rank_and_rendezvous_epochs():
+    master = create_master(
+        training_shards=[("f", 0, 8)], records_per_task=8, rendezvous=True
+    )
+    try:
+        mc0 = create_master_client(master, worker_id=0)
+        mc1 = create_master_client(master, worker_id=1)
+        mc0.report_train_loop_status(pb.LOOP_START)
+        mc1.report_train_loop_status(pb.LOOP_START)
+        time.sleep(0.15)  # grace window
+        r0 = mc0.get_comm_rank()
+        r1 = mc1.get_comm_rank()
+        assert {r0.rank_id, r1.rank_id} == {0, 1}
+        assert r0.world_size == 2
+        first_id = r0.rendezvous_id
+        # worker 1 leaves -> epoch bumps, world shrinks
+        mc1.report_train_loop_status(pb.LOOP_END)
+        time.sleep(0.15)
+        r0b = mc0.get_comm_rank()
+        assert r0b.world_size == 1
+        assert r0b.rendezvous_id > first_id
+    finally:
+        master.stop()
+
+
+def test_evaluation_flow_end_to_end():
+    master = create_master(
+        training_shards=[("f", 0, 32)],
+        evaluation_shards=[("e", 0, 8)],
+        records_per_task=8,
+        evaluation_steps=10,
+        metrics_factory=lambda: {"accuracy": metrics.Accuracy()},
+    )
+    try:
+        mc = create_master_client(master)
+        # version report triggers an eval job
+        mc.report_version(10)
+        t = mc.get_task()
+        assert t.type == pb.EVALUATION
+        outputs = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+        labels = np.array([0, 0], np.int32)
+        mc.report_evaluation_metrics(outputs, labels)
+        mc.report_task_result(t.id)
+        history = master.evaluation_service.history
+        assert history and history[0][0] == 10
+        assert abs(history[0][1]["accuracy"] - 0.5) < 1e-6
+    finally:
+        master.stop()
+
+
+def test_batch_done_counters():
+    master = create_master(training_shards=[("f", 0, 8)], records_per_task=8)
+    try:
+        mc = create_master_client(master, worker_id=3)
+        mc.report_batch_done(5)
+        mc.report_batch_done(3)
+        assert master.servicer.worker_record_counts[3] == 8
+    finally:
+        master.stop()

@@ -1,0 +1,35 @@
+import numpy as np
+
+from elasticdl_tpu.utils import metrics
+
+
+def test_accuracy():
+    m = metrics.Accuracy()
+    m.update(np.array([[0.9, 0.1], [0.1, 0.9]]), np.array([0, 0]))
+    assert abs(m.result() - 0.5) < 1e-9
+
+
+def test_binary_accuracy():
+    m = metrics.BinaryAccuracy()
+    m.update(np.array([0.9, 0.2, 0.7]), np.array([1, 0, 0]))
+    assert abs(m.result() - 2 / 3) < 1e-9
+
+
+def test_mse_streams():
+    m = metrics.MeanSquaredError()
+    m.update(np.array([1.0, 2.0]), np.array([0.0, 0.0]))
+    m.update(np.array([3.0]), np.array([0.0]))
+    assert abs(m.result() - (1 + 4 + 9) / 3) < 1e-9
+
+
+def test_auc_perfect_and_random():
+    m = metrics.AUC()
+    scores = np.concatenate([np.random.rand(500) * 0.4,
+                             0.6 + np.random.rand(500) * 0.4])
+    labels = np.concatenate([np.zeros(500), np.ones(500)])
+    m.update(scores, labels)
+    assert m.result() > 0.99
+    m2 = metrics.AUC()
+    rng = np.random.RandomState(0)
+    m2.update(rng.rand(4000), rng.randint(0, 2, 4000))
+    assert 0.45 < m2.result() < 0.55

@@ -1,0 +1,14 @@
+from elasticdl_tpu.data.recio import RecioReader, RecioWriter
+
+
+def test_recio_roundtrip(tmp_path):
+    path = str(tmp_path / "data.recio")
+    records = [b"hello", b"", b"x" * 1000, b"last"]
+    with RecioWriter(path) as w:
+        for r in records:
+            w.write(r)
+    with RecioReader(path) as r:
+        assert len(r) == 4
+        assert [r.read(i) for i in range(4)] == records
+        assert list(r.read_range(1, 3)) == records[1:3]
+        assert list(r.read_range(2, 99)) == records[2:]

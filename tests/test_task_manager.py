@@ -1,0 +1,140 @@
+import time
+
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto import elastic_pb2 as pb
+
+
+def make_tm(**kw):
+    defaults = dict(
+        training_shards=[("f", 0, 100)], records_per_task=30, num_epochs=1
+    )
+    defaults.update(kw)
+    return TaskManager(**defaults)
+
+
+def test_shard_splitting():
+    tm = make_tm()
+    sizes = []
+    while True:
+        t = tm.get(0)
+        if t is None:
+            break
+        sizes.append(t.shard.size)
+        tm.report(t.id, True)
+    assert sizes == [30, 30, 30, 10]
+    assert tm.finished()
+
+
+def test_report_failure_requeues_up_to_max_retries():
+    tm = make_tm(training_shards=[("f", 0, 10)], records_per_task=10,
+                 max_task_retries=2)
+    t = tm.get(0)
+    for _ in range(2):
+        result = tm.report(t.id, False, "boom")
+        assert not result.ok and not result.permanent_failure
+        t = tm.get(0)
+        assert t is not None
+    result = tm.report(t.id, False, "boom")  # exceeds retries
+    assert result.permanent_failure
+    assert tm.get(0) is None
+    assert tm.failed_counts[pb.TRAINING] == 1
+    assert tm.finished()
+
+
+def test_epochs_regenerate_tasks():
+    tm = make_tm(
+        training_shards=[("f", 0, 20)], records_per_task=10, num_epochs=3
+    )
+    done = 0
+    while True:
+        t = tm.get(0)
+        if t is None:
+            break
+        tm.report(t.id, True)
+        done += 1
+    assert done == 6  # 2 tasks x 3 epochs
+    assert tm.finished()
+
+
+def test_shuffle_produces_record_indices():
+    tm = make_tm(
+        training_shards=[("f", 0, 16)], records_per_task=8,
+        shuffle=True, seed=42,
+    )
+    t = tm.get(0)
+    assert sorted(t.shard.record_indices) == list(range(t.shard.start,
+                                                        t.shard.end))
+
+
+def test_recover_tasks_requeues_dead_workers_tasks():
+    tm = make_tm(training_shards=[("f", 0, 40)], records_per_task=10)
+    t1 = tm.get(1)
+    t2 = tm.get(1)
+    t3 = tm.get(2)
+    tm.recover_tasks(1)
+    counts = tm.counts()
+    assert counts["todo"] == 3  # 1 untouched + 2 recovered
+    assert counts["doing"] == 1
+    tm.report(t3.id, True)
+    ids = set()
+    while True:
+        t = tm.get(3)
+        if t is None:
+            break
+        ids.add(t.id)
+        tm.report(t.id, True)
+    assert t1.id in ids and t2.id in ids
+
+
+def test_timeout_watchdog_requeues_and_notifies():
+    tm = make_tm(
+        training_shards=[("f", 0, 10)], records_per_task=10,
+        task_timeout_secs=0.01,
+    )
+    timed_out_workers = []
+    tm.add_worker_timeout_callback(timed_out_workers.append)
+    tm._watchdog_interval = 0.05
+    t = tm.get(7)
+    # run one watchdog sweep inline instead of waiting 5s
+    time.sleep(0.05)
+    tm._stopped.set()
+    threshold = tm._timeout_threshold()
+    assert threshold <= 0.05 or threshold == 0.01
+    # simulate the sweep
+    tm.report(t.id, False, "timeout")
+    for fn in tm._worker_timeout_callbacks:
+        fn(7)
+    assert timed_out_workers == [7]
+    assert tm.counts()["todo"] == 1
+
+
+def test_train_end_callback_task_dispatched_once():
+    tm = make_tm(training_shards=[("f", 0, 10)], records_per_task=10)
+    tm.set_train_end_callback_task()
+    t = tm.get(0)
+    tm.report(t.id, True)
+    assert not tm.finished()
+    cb = tm.get(0)
+    assert cb is not None and cb.type == pb.TRAIN_END_CALLBACK
+    assert tm.get(1) is None  # only one callback task
+    tm.report(cb.id, True)
+    assert tm.finished()
+
+
+def test_evaluation_tasks_interleave():
+    tm = make_tm(
+        training_shards=[("f", 0, 20)],
+        evaluation_shards=[("e", 0, 10)],
+        records_per_task=10,
+    )
+    n = tm.create_evaluation_tasks(model_version=5)
+    assert n == 1
+    types = []
+    while True:
+        t = tm.get(0)
+        if t is None:
+            break
+        types.append(t.type)
+        tm.report(t.id, True)
+    assert types[0] == pb.EVALUATION  # eval jumps the queue
+    assert types.count(pb.TRAINING) == 2

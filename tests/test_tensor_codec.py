@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from elasticdl_tpu.utils import tensor_codec
+
+
+def test_ndarray_roundtrip():
+    for dtype in ("float32", "float64", "int32", "int64", "uint8"):
+        a = (np.arange(24).reshape(2, 3, 4) % 7).astype(dtype)
+        b = tensor_codec.pb_to_ndarray(tensor_codec.ndarray_to_pb(a))
+        np.testing.assert_array_equal(a, b)
+        assert b.dtype == a.dtype
+
+
+def test_bfloat16_roundtrip():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    a = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    b = tensor_codec.pb_to_ndarray(tensor_codec.ndarray_to_pb(a))
+    assert b.dtype == a.dtype
+    np.testing.assert_array_equal(
+        a.astype(np.float32), b.astype(np.float32)
+    )
+
+
+def test_indexed_slices_roundtrip():
+    values = np.random.rand(3, 4).astype(np.float32)
+    ids = [7, 2, 7]
+    s = tensor_codec.indexed_slices_to_pb(values, ids)
+    v2, i2 = tensor_codec.pb_to_indexed_slices(s)
+    np.testing.assert_array_equal(values, v2)
+    np.testing.assert_array_equal(np.array(ids), i2)
+
+
+def test_merge_indexed_slices_sums_duplicates():
+    values = np.array([[1.0, 1.0], [2.0, 2.0], [4.0, 4.0]], np.float32)
+    merged, uniq = tensor_codec.merge_indexed_slices(values, [5, 3, 5])
+    np.testing.assert_array_equal(uniq, [3, 5])
+    np.testing.assert_allclose(merged, [[2.0, 2.0], [5.0, 5.0]])
+
+
+def test_model_pb_roundtrip():
+    dense = {"w": np.ones((2, 2), np.float32)}
+    emb = {"table": (np.random.rand(2, 3).astype(np.float32), [1, 9])}
+    infos = [{"name": "table", "dim": 3}]
+    m = tensor_codec.model_to_pb(
+        dense=dense, embeddings=emb, infos=infos, version=7
+    )
+    d2, e2, i2, v = tensor_codec.pb_to_model(m)
+    assert v == 7
+    np.testing.assert_array_equal(d2["w"], dense["w"])
+    np.testing.assert_array_equal(e2["table"][1], [1, 9])
+    assert i2[0]["name"] == "table" and i2[0]["dim"] == 3

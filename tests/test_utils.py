@@ -1,0 +1,48 @@
+"""In-process cluster harness (reference pattern:
+elasticdl/python/tests/test_utils.py:301-472 — the whole distributed system
+in one process, real gRPC on localhost ports)."""
+
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.worker.master_client import MasterClient
+
+
+def create_master(
+    training_shards=None,
+    evaluation_shards=None,
+    records_per_task=32,
+    num_epochs=1,
+    evaluation_steps=0,
+    metrics_factory=None,
+    rendezvous=False,
+    **task_kwargs,
+):
+    task_manager = TaskManager(
+        training_shards=training_shards,
+        evaluation_shards=evaluation_shards,
+        records_per_task=records_per_task,
+        num_epochs=num_epochs,
+        **task_kwargs,
+    )
+    evaluation_service = None
+    if evaluation_steps and metrics_factory:
+        evaluation_service = EvaluationService(
+            task_manager, metrics_factory, evaluation_steps=evaluation_steps
+        )
+    rdzv = RendezvousServer(grace_secs=0.1) if rendezvous else None
+    master = Master(
+        task_manager,
+        rendezvous_server=rdzv,
+        evaluation_service=evaluation_service,
+    )
+    master.prepare()
+    return master
+
+
+def create_master_client(master, worker_id=0):
+    channel = grpc_utils.build_channel("localhost:%d" % master.port)
+    grpc_utils.wait_for_channel_ready(channel)
+    return MasterClient(channel, worker_id=worker_id)
