@@ -1,0 +1,83 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Reference baseline: 145 images/s on 1x NVIDIA P100 for ResNet-50/ImageNet
+(docs/benchmark/ftlib_benchmark.md:121; see BASELINE.md).  This measures
+the same model shape (ResNet-50, 224x224x3, 1000 classes) running the
+framework's jitted train step in bfloat16 on one TPU chip, with the batch
+resident on device (synthetic data; the data plane is benchmarked
+separately).
+
+Note: on this session's axon relay platform, ``jax.block_until_ready`` does
+not actually fence remote execution — timing must close with a value fetch.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+BASELINE_IMAGES_PER_SEC = 145.0  # ftlib_benchmark.md:121 (1x P100)
+
+
+def run_bench(batch_size=128, warmup=3, iters=20):
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.models import resnet
+    from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # Keep the CPU fallback fast enough to not time out; the real
+        # number comes from the TPU run.
+        batch_size, warmup, iters = 16, 1, 3
+
+    spec = resnet.model_spec(variant="resnet50", num_classes=1000,
+                             image_size=224, learning_rate=0.1)
+    trainer = CollectiveTrainer(
+        spec, batch_size=batch_size, use_bf16_compute=True
+    )
+    rng = np.random.RandomState(0)
+    xs = jax.device_put(
+        rng.rand(batch_size, 224, 224, 3).astype(np.float32)
+    )
+    ys = jax.device_put(
+        rng.randint(0, 1000, size=batch_size).astype(np.int32)
+    )
+    ws = jax.device_put(np.ones((batch_size,), np.float32))
+
+    params, opt_state = trainer._params, trainer._opt_state
+    step = trainer._train_step
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, xs, ys, ws)
+    float(loss)  # fence
+
+    start = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, xs, ys, ws)
+    last_loss = float(loss)  # fence
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = batch_size * iters / elapsed
+    return {
+        "metric": "resnet50_train_throughput",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+        "detail": {
+            "platform": platform,
+            "batch_size": batch_size,
+            "iters": iters,
+            "last_loss": last_loss,
+            "baseline": "145 img/s ResNet-50/ImageNet 1xP100 "
+                        "(ftlib_benchmark.md:121)",
+        },
+    }
+
+
+if __name__ == "__main__":
+    result = run_bench()
+    print(json.dumps(result))
+    sys.exit(0)

@@ -1,0 +1,130 @@
+"""ResNet family — the reference's headline benchmark model
+(model_zoo/cifar10 and model_zoo/resnet50_subclass; perf baselines in
+docs/benchmark/ftlib_benchmark.md).
+
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU),
+GroupNorm instead of BatchNorm (no cross-replica batch-stats sync, no
+mutable state threading through the jitted step, identical FLOP profile),
+and bf16-friendly initializers.  Compute dtype is controlled by the
+trainer (use_bf16_compute) so the MXU runs in bfloat16 with float32 params.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.utils import metrics
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    groups: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        def gn(channels):
+            # group count that always divides the channel count
+            return nn.GroupNorm(num_groups=int(np.gcd(self.groups,
+                                                      channels)))
+
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        y = gn(self.features)(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", use_bias=False,
+        )(y)
+        y = gn(self.features)(y)
+        y = nn.relu(y)
+        out_features = self.features * 4
+        y = nn.Conv(out_features, (1, 1), use_bias=False)(y)
+        y = gn(out_features)(y)
+        if residual.shape[-1] != out_features or self.strides != 1:
+            residual = nn.Conv(
+                out_features, (1, 1),
+                strides=(self.strides, self.strides), use_bias=False,
+            )(residual)
+            residual = gn(out_features)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: tuple = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    cifar_stem: bool = False            # 3x3/1 stem for 32x32 inputs
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        if self.cifar_stem:
+            x = nn.Conv(self.width, (3, 3), padding="SAME",
+                        use_bias=False)(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=int(np.gcd(32, self.width)))(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, blocks in enumerate(self.stage_sizes):
+            features = self.width * (2 ** stage)
+            for block in range(blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(features=features, strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes,
+                        kernel_init=nn.initializers.zeros_init())(x)
+
+
+def _make_spec(model, name, input_shape, learning_rate, momentum=0.9):
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1,) + input_shape))["params"]
+
+    def apply_fn(params, x, train):
+        return model.apply({"params": params}, x, train=train)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        )
+
+    def feed(records):
+        xs = np.stack(
+            [np.asarray(r[0], dtype=np.float32) for r in records]
+        )
+        ys = np.asarray([int(r[1]) for r in records], dtype=np.int32)
+        return xs, ys
+
+    return ModelSpec(
+        name=name,
+        init_fn=init_fn,
+        apply_fn=apply_fn,
+        loss_fn=loss_fn,
+        optimizer=optax.sgd(learning_rate, momentum=momentum),
+        feed=feed,
+        eval_metrics_fn=lambda: {"accuracy": metrics.Accuracy()},
+    )
+
+
+def model_spec(variant="resnet50", num_classes=1000, image_size=224,
+               learning_rate=0.1):
+    """Zoo entry.  variant: resnet50 | resnet50_cifar10 | resnet18_cifar10."""
+    if variant == "resnet50":
+        model = ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes)
+        return _make_spec(model, "resnet50",
+                          (image_size, image_size, 3), learning_rate)
+    if variant == "resnet50_cifar10":
+        model = ResNet(stage_sizes=(3, 4, 6, 3), num_classes=10,
+                       cifar_stem=True)
+        return _make_spec(model, "resnet50_cifar10", (32, 32, 3),
+                          learning_rate)
+    if variant == "resnet_small_cifar10":
+        model = ResNet(stage_sizes=(2, 2, 2, 2), num_classes=10,
+                       cifar_stem=True)
+        return _make_spec(model, "resnet_small_cifar10", (32, 32, 3),
+                          learning_rate)
+    raise ValueError("unknown resnet variant %r" % variant)

@@ -1,0 +1,60 @@
+"""Device-mesh construction for dp/pp/tp/sp parallelism.
+
+The reference's only parallelism is data-parallel (SURVEY.md §2.12); the
+TPU-native framework makes the mesh a first-class object: axes are chosen
+once, shardings are annotated, and XLA inserts the collectives over ICI.
+"""
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+def build_mesh(dp=None, pp=1, tp=1, sp=1, devices=None):
+    """Build a Mesh with axes (dp, pp, tp, sp).
+
+    dp=None means "whatever is left" after pp*tp*sp.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = pp * tp * sp
+    if dp is None:
+        if n % fixed:
+            raise ValueError(
+                "%d devices not divisible by pp*tp*sp=%d" % (n, fixed)
+            )
+        dp = n // fixed
+    if dp * fixed != n:
+        raise ValueError(
+            "dp*pp*tp*sp=%d != %d devices" % (dp * fixed, n)
+        )
+    arr = np.array(devices).reshape(dp, pp, tp, sp)
+    return Mesh(arr, AXES)
+
+
+def data_mesh(devices=None):
+    """Pure data-parallel mesh (the elastic AllReduce replacement)."""
+    return build_mesh(dp=None, devices=devices)
+
+
+def factor_mesh(n, want_tp=True, want_sp=True):
+    """Heuristic axis sizing for n devices: give tp/sp a factor of 2 each
+    when available, rest to dp."""
+    tp = 2 if want_tp and n % 2 == 0 else 1
+    rem = n // tp
+    sp = 2 if want_sp and rem % 2 == 0 else 1
+    dp = rem // sp
+    return dict(dp=dp, pp=1, tp=tp, sp=sp)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh, *axis_names):
+    """Sharding for a batch tensor: dim 0 over dp (and any extra names)."""
+    return NamedSharding(mesh, P(tuple(["dp"] + list(axis_names))))

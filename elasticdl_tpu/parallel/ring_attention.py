@@ -1,0 +1,112 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+Long-context support the reference lacks entirely (SURVEY.md §5.7).
+Sequence is sharded over the ``sp`` mesh axis; each device holds a query
+block and streams key/value blocks around the ring with ``ppermute``,
+folding every block into a numerically-stable online softmax (the same
+accumulation flash attention uses, distributed over devices).  Peak memory
+per device is O(T/sp · T/sp) instead of O(T²), and the KV transfers ride
+ICI concurrently with compute.
+
+Layout convention: [batch, seq, heads, head_dim]; heads shard over ``tp``,
+sequence over ``sp``, batch over ``dp``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _online_block(q, k, v, o, l, m, q_pos, k_pos, scale, causal):
+    """Fold one KV block into the (o, l, m) online-softmax state."""
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]          # [Tq, Tk]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))               # [B,H,Tq]
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                    # [B,H,Tq,Tk]
+    l = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    )
+    o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o, l, m_new
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    axis_size = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    q_pos = rank * tq + jnp.arange(tq)
+
+    o = jnp.zeros((b, tq, h, d), jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+    m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+
+    def body(i, carry):
+        o, l, m, k, v = carry
+        src_rank = (rank - i) % axis_size
+        k_pos = src_rank * tk + jnp.arange(tk)
+        o, l, m = _online_block(q, k, v, o, l, m, q_pos, k_pos, scale,
+                                causal)
+        # pass our current KV block along the ring
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return o, l, m, k, v
+
+    o, l, m, k, v = jax.lax.fori_loop(
+        0, axis_size, body, (o, l, m, k, v)
+    )
+    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype)
+
+
+def attention_local(q, k, v, causal=True, scale=None):
+    """Single-device reference attention (same layout, same math)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    )
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, causal=True, scale=None,
+                   dp_axis="dp", sp_axis="sp", tp_axis="tp"):
+    """Sequence-parallel attention over mesh axis ``sp``.
+
+    q, k, v: [batch, seq, heads, head_dim] global arrays (or sharded).
+    Falls back to local attention when the mesh has no sp extent.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if mesh is None or mesh.shape.get(sp_axis, 1) == 1:
+        return attention_local(q, k, v, causal=causal, scale=scale)
+    spec = P(dp_axis, sp_axis, tp_axis, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=sp_axis, causal=causal, scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,80 @@
+"""SPMD trainer: jitted train step over a 4-axis mesh with model-parallel
+parameter shardings.
+
+Complements worker/collective_trainer.py (which replicates params — the
+pure-DP elastic path): here parameters, optimizer state and activations all
+carry PartitionSpecs, so one jitted step expresses dp+pp+tp+sp and XLA
+emits the collectives over ICI.  Optimizer state shardings are *inferred*
+by compiling ``tx.init`` with sharded params in — GSPMD propagates the
+param shardings onto Adam's mu/nu without hand-annotating optax internals.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class SPMDTrainer:
+    def __init__(
+        self,
+        mesh,
+        init_fn,            # rng -> params (unsharded ok)
+        loss_fn,            # (params, batch) -> scalar loss
+        optimizer,
+        param_specs,        # PartitionSpec pytree matching params
+        batch_spec=P("dp"),
+        rng_seed=0,
+        donate=True,
+    ):
+        self.mesh = mesh
+        self._loss_fn = loss_fn
+        self._tx = optimizer
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
+
+        params = init_fn(jax.random.PRNGKey(rng_seed))
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.params = jax.tree_util.tree_map(
+            jax.device_put, params, shardings
+        )
+        # opt-state shardings follow the params via GSPMD propagation
+        self.opt_state = jax.jit(self._tx.init)(self.params)
+        self.version = 0
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(
+            step, donate_argnums=(0, 1) if donate else ()
+        )
+
+        def eval_loss(params, batch):
+            return self._loss_fn(params, batch)
+
+        self._eval = jax.jit(eval_loss)
+
+    def put_batch(self, batch):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._batch_sharding), batch
+        )
+
+    def train_step(self, batch):
+        batch = self.put_batch(batch)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, batch
+        )
+        self.version += 1
+        return loss
+
+    def eval_loss(self, batch):
+        return self._eval(self.params, self.put_batch(batch))

@@ -1,0 +1,112 @@
+"""Flagship transformer: forward parity across parallelism layouts, and a
+full 4-axis (dp/pp/tp/sp) train step on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.models import transformer as tfm
+from elasticdl_tpu.parallel.mesh import build_mesh
+from elasticdl_tpu.parallel.spmd_trainer import SPMDTrainer
+
+CFG = tfm.TransformerConfig(
+    vocab_size=128, dim=64, num_heads=4, num_layers=2,
+    max_seq_len=32, dtype="float32",
+)
+
+
+def make_tokens(b=4, t=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG.vocab_size, size=(b, t)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_forward_shapes_and_finite(params):
+    tokens = make_tokens()
+    logits = tfm.forward(params, tokens, CFG)
+    assert logits.shape == (4, 32, CFG.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.parametrize(
+    "axes", [dict(dp=2, tp=2, sp=2), dict(dp=1, tp=4, sp=2),
+             dict(dp=8, tp=1, sp=1), dict(dp=1, pp=2, tp=2, sp=2)]
+)
+def test_sharded_forward_matches_single_device(params, axes):
+    tokens = make_tokens()
+    ref = np.asarray(tfm.forward(params, tokens, CFG))
+    mesh = build_mesh(**axes)
+    sharded = tfm.shard_params(params, mesh, CFG)
+    out = jax.jit(
+        lambda p, t: tfm.forward(p, t, CFG, mesh=mesh)
+    )(sharded, tokens)
+    np.testing.assert_allclose(ref, np.asarray(out), rtol=5e-4, atol=5e-4)
+
+
+def test_full_4axis_train_step():
+    mesh = build_mesh(dp=1, pp=2, tp=2, sp=2)
+
+    def loss_fn(params, batch):
+        tokens, _ = batch
+        logits = tfm.forward(params, tokens, CFG, mesh=mesh)
+        return tfm.next_token_loss(logits, tokens).mean()
+
+    trainer = SPMDTrainer(
+        mesh,
+        init_fn=lambda rng: tfm.init_params(rng, CFG),
+        loss_fn=loss_fn,
+        optimizer=optax.adamw(1e-3),
+        param_specs=tfm.param_specs(CFG),
+        batch_spec=P("dp", "sp"),
+    )
+    tokens = make_tokens(b=4)
+    losses = [float(trainer.train_step((tokens, tokens))) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+def test_loss_decreases_matches_unsharded_trajectory():
+    """dp/tp/sp sharded training must follow the single-device trajectory."""
+    tokens = make_tokens(b=4)
+    tx = optax.sgd(0.1)
+
+    def make_loss(mesh):
+        def loss_fn(params, batch):
+            toks, _ = batch
+            logits = tfm.forward(params, toks, CFG, mesh=mesh)
+            return tfm.next_token_loss(logits, toks).mean()
+        return loss_fn
+
+    # single device
+    params = tfm.init_params(jax.random.PRNGKey(1), CFG)
+    loss_single = make_loss(None)
+    opt = tx.init(params)
+    traj_single = []
+    p = params
+    for _ in range(3):
+        l, g = jax.value_and_grad(loss_single)(p, (tokens, tokens))
+        u, opt = tx.update(g, opt, p)
+        p = optax.apply_updates(p, u)
+        traj_single.append(float(l))
+
+    mesh = build_mesh(dp=2, tp=2, sp=2)
+    trainer = SPMDTrainer(
+        mesh,
+        init_fn=lambda rng: tfm.init_params(rng, CFG),
+        loss_fn=make_loss(mesh),
+        optimizer=tx,
+        param_specs=tfm.param_specs(CFG),
+        batch_spec=P("dp", "sp"),
+        rng_seed=1,
+    )
+    traj_sharded = [
+        float(trainer.train_step((tokens, tokens))) for _ in range(3)
+    ]
+    np.testing.assert_allclose(traj_single, traj_sharded, rtol=2e-3)
