@@ -24,6 +24,17 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
         xs = rng.rand(n, 32, 32, 3).astype(np.float32)
         ys = rng.randint(0, 10, size=n).astype(np.int32)
         return ArrayDataReader((xs, ys), records_per_shard=records_per_shard)
+    if data_origin.startswith("synthetic_ctr"):
+        from elasticdl_tpu.data.reader import ArrayDataReader
+        from elasticdl_tpu.models import deepfm
+
+        _, _, n = data_origin.partition(":")
+        dense, ids, labels = deepfm.synthetic_data(
+            n=int(n) if n else 4096
+        )
+        return ArrayDataReader(
+            (dense, ids, labels), records_per_shard=records_per_shard
+        )
     if data_origin.endswith(".csv"):
         from elasticdl_tpu.data.reader import TextDataReader
 

@@ -72,23 +72,38 @@ def build_master(args):
         RendezvousServer()
         if args.distribution_strategy == "collective" else None
     )
+    ps_manager = None
+    if args.distribution_strategy == "ps" and args.num_ps > 0:
+        from elasticdl_tpu.master.ps_manager import PSManager
+
+        opt_type, opt_args = spec.ps_optimizer
+        ps_manager = PSManager(
+            args.num_ps, opt_type, opt_args,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_steps=args.checkpoint_steps,
+            evaluation_steps=args.evaluation_steps,
+        )
     worker_manager = None
     if args.num_workers > 0:
         worker_args = build_arguments_from_parsed_result(
             args, filter_args=_MASTER_ONLY_ARGS
         )
+        if ps_manager is not None:
+            worker_args += ["--ps_addrs", ps_manager.addrs]
         worker_manager = WorkerManager(
             ProcessWorkerBackend(worker_args=worker_args),
             num_workers=args.num_workers,
             max_relaunch_count=args.relaunch_on_worker_failure,
         )
-    return Master(
+    master = Master(
         task_manager,
         rendezvous_server=rendezvous,
         evaluation_service=evaluation_service,
         worker_manager=worker_manager,
         port=args.port,
     )
+    master.ps_manager = ps_manager
+    return master
 
 
 def main(argv=None):
@@ -96,7 +111,14 @@ def main(argv=None):
     logger.info("master starting: %s", vars(args))
     master = build_master(args)
     master.prepare()
-    return master.run()
+    if getattr(master, "ps_manager", None) is not None:
+        master.ps_manager._master_addr = "localhost:%d" % master.port
+        master.ps_manager.start()
+    try:
+        return master.run()
+    finally:
+        if getattr(master, "ps_manager", None) is not None:
+            master.ps_manager.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,105 @@
+"""Parameter-server process lifecycle.
+
+The master launches/watches/relaunches PS shards the way it does workers
+(reference: PS pods in pod_manager, protected by priority; relaunch uses
+``checkpoint_dir_for_init`` so a fresh shard restores its hash-routed slice
+of the latest checkpoint — go/pkg/ps/checkpoint.go:98-133 semantics).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+from elasticdl_tpu.utils.grpc_utils import find_free_port
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PSManager:
+    def __init__(self, num_ps, opt_type, opt_args, master_addr="",
+                 checkpoint_dir="", checkpoint_steps=0,
+                 evaluation_steps=0, use_async=True, grads_to_wait=1,
+                 max_relaunch=5):
+        self.num_ps = num_ps
+        self._opt_type = opt_type
+        self._opt_args = opt_args
+        self._master_addr = master_addr
+        self._checkpoint_dir = checkpoint_dir
+        self._checkpoint_steps = checkpoint_steps
+        self._evaluation_steps = evaluation_steps
+        self._use_async = use_async
+        self._grads_to_wait = grads_to_wait
+        self._max_relaunch = max_relaunch
+        self.ports = [find_free_port() for _ in range(num_ps)]
+        self._procs = {}
+        self._relaunches = {}
+        self._stopped = threading.Event()
+
+    @property
+    def addrs(self):
+        return ",".join("localhost:%d" % p for p in self.ports)
+
+    def _args(self, ps_id, restore):
+        args = [
+            "--port", str(self.ports[ps_id]),
+            "--ps_id", str(ps_id),
+            "--num_ps", str(self.num_ps),
+            "--opt_type", self._opt_type,
+            "--opt_args", self._opt_args,
+            "--use_async", str(self._use_async),
+            "--grads_to_wait", str(self._grads_to_wait),
+            "--evaluation_steps", str(self._evaluation_steps),
+        ]
+        if self._master_addr:
+            args += ["--master_addr", self._master_addr]
+        if self._checkpoint_dir:
+            args += [
+                "--checkpoint_dir", self._checkpoint_dir,
+                "--checkpoint_steps", str(self._checkpoint_steps),
+            ]
+            if restore:
+                args += ["--checkpoint_dir_for_init",
+                         self._checkpoint_dir]
+        return args
+
+    def _launch(self, ps_id, restore=False):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "elasticdl_tpu.ps.server"]
+            + self._args(ps_id, restore),
+            env=env,
+        )
+        self._procs[ps_id] = proc
+        logger.info("launched PS %d on port %d (restore=%s)",
+                    ps_id, self.ports[ps_id], restore)
+        threading.Thread(
+            target=self._watch, args=(ps_id, proc),
+            name="ps-watch-%d" % ps_id, daemon=True,
+        ).start()
+
+    def _watch(self, ps_id, proc):
+        code = proc.wait()
+        if self._stopped.is_set():
+            return
+        count = self._relaunches.get(ps_id, 0)
+        if count >= self._max_relaunch:
+            logger.error("PS %d died (code %s); relaunch budget spent",
+                         ps_id, code)
+            return
+        self._relaunches[ps_id] = count + 1
+        logger.warning("PS %d died (code %s); relaunching with restore",
+                       ps_id, code)
+        self._launch(ps_id, restore=bool(self._checkpoint_dir))
+
+    def start(self):
+        for ps_id in range(self.num_ps):
+            self._launch(ps_id)
+
+    def stop(self):
+        self._stopped.set()
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.terminate()

@@ -1,0 +1,131 @@
+"""DeepFM with PS-served embeddings — the sparse CTR path.
+
+Counterpart of the reference's model_zoo/deepfm_functional_api and
+dac_ctr zoo (deepfm_edl_embedding uses PS-backed elasticdl.layers.Embedding,
+SURVEY.md §2.11).  Two PS tables: second-order factor embeddings [V, k] and
+first-order linear weights [V, 1]; the dense MLP weights also live on the
+PS (pushed/pulled by the ParameterServerTrainer).
+
+Feature convention: categorical ids are pre-offset into one vocab space
+(the reference's ConcatenateWithOffset pattern); features arrive as
+  {"dense": [B, Dn] float, "__ids__": {"deepfm_embedding": [B, F],
+                                       "deepfm_linear": [B, F]}}
+and the trainer injects  emb__<table> ([U, dim] pulled rows) and
+idx__<table> ([B, F] gather indices).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.utils import metrics
+
+EMB_TABLE = "deepfm_embedding"
+LIN_TABLE = "deepfm_linear"
+
+
+def init_params(rng, num_dense, num_fields, embedding_dim,
+                hidden=(128, 64)):
+    sizes = [num_fields * embedding_dim + num_dense] + list(hidden) + [1]
+    keys = jax.random.split(rng, len(sizes))
+    params = {"bias": jnp.zeros((1,), jnp.float32)}
+    for i in range(len(sizes) - 1):
+        params["w%d" % i] = (
+            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i])
+        ).astype(jnp.float32)
+        params["b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    return params
+
+
+def forward(params, feats, train):
+    emb_rows = feats["emb__" + EMB_TABLE]        # [U, k]
+    emb_idx = feats["idx__" + EMB_TABLE]         # [B, F]
+    lin_rows = feats["emb__" + LIN_TABLE]        # [U, 1]
+    lin_idx = feats["idx__" + LIN_TABLE]         # [B, F]
+    dense = feats.get("dense")
+
+    v = emb_rows[emb_idx]                        # [B, F, k]
+    # first-order term
+    first = lin_rows[lin_idx][..., 0].sum(axis=1)            # [B]
+    # FM second-order: 0.5 * ((sum v)^2 - sum v^2)
+    sum_v = v.sum(axis=1)                                    # [B, k]
+    second = 0.5 * (
+        jnp.square(sum_v) - jnp.square(v).sum(axis=1)
+    ).sum(axis=-1)                                           # [B]
+    # deep part
+    flat = v.reshape(v.shape[0], -1)
+    x = jnp.concatenate([flat, dense], axis=-1) if dense is not None \
+        else flat
+    n_layers = sum(1 for k in params if k.startswith("w"))
+    for i in range(n_layers):
+        x = x @ params["w%d" % i] + params["b%d" % i]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    deep = x[:, 0]                                           # [B]
+    return first + second + deep + params["bias"][0]
+
+
+def model_spec(num_dense=4, num_fields=8, vocab_size=10000,
+               embedding_dim=8, learning_rate=1e-3, hidden=(128, 64)):
+    def init_fn(rng):
+        return init_params(rng, num_dense, num_fields, embedding_dim,
+                           hidden)
+
+    def loss_fn(logits, labels):
+        return optax.sigmoid_binary_cross_entropy(
+            logits, labels.astype(jnp.float32)
+        )
+
+    def feed(records):
+        dense = np.stack(
+            [np.asarray(r[0], np.float32) for r in records]
+        )
+        ids = np.stack([np.asarray(r[1], np.int64) for r in records])
+        labels = np.asarray([int(r[2]) for r in records], np.int32)
+        return (
+            {
+                "dense": dense,
+                "__ids__": {EMB_TABLE: ids, LIN_TABLE: ids},
+            },
+            labels,
+        )
+
+    return ModelSpec(
+        name="deepfm",
+        init_fn=init_fn,
+        apply_fn=lambda params, feats, train: forward(params, feats,
+                                                      train),
+        loss_fn=loss_fn,
+        optimizer=optax.adam(learning_rate),
+        feed=feed,
+        eval_metrics_fn=lambda: {
+            "auc": metrics.AUC(),
+            "accuracy": metrics.BinaryAccuracy(threshold=0.0),
+        },
+        ps_embedding_infos=[
+            {"name": EMB_TABLE, "dim": embedding_dim,
+             "initializer": "uniform"},
+            {"name": LIN_TABLE, "dim": 1, "initializer": "zeros"},
+        ],
+        ps_optimizer=("adam", "learning_rate=%g" % learning_rate),
+    )
+
+
+def synthetic_data(n=1024, num_dense=4, num_fields=8, vocab_size=10000,
+                   seed=0):
+    """Learnable synthetic CTR data: the label depends on a hidden weight
+    per category id, so embeddings must be learned for AUC > 0.5."""
+    rng = np.random.RandomState(seed)
+    hidden_w = rng.randn(vocab_size) * 0.5
+    dense = rng.rand(n, num_dense).astype(np.float32)
+    field_offsets = (
+        np.arange(num_fields) * (vocab_size // num_fields)
+    )
+    raw = rng.randint(0, vocab_size // num_fields, size=(n, num_fields))
+    ids = (raw + field_offsets[None, :]).astype(np.int64)
+    score = hidden_w[ids].sum(axis=1) + dense.sum(axis=1) - num_dense / 2
+    labels = (score > 0).astype(np.int32)
+    return dense, ids, labels

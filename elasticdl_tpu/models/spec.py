@@ -33,6 +33,10 @@ class ModelSpec:
     # Optional: names of embedding tables served by the parameter server
     # (the sparse path); empty for pure dense models.
     ps_embedding_infos: list = dataclasses.field(default_factory=list)
+    # PS-side optimizer as (opt_type, opt_args) flag strings — the analog
+    # of the reference's Keras-optimizer -> Go-PS-flags mapping
+    # (model_utils.py:227-254).
+    ps_optimizer: tuple = ("sgd", "learning_rate=0.1")
 
 
 def load_model_spec(module_name, **kwargs):

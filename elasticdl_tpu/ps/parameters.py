@@ -1,0 +1,116 @@
+"""Parameter-server state: dense params + native embedding tables.
+
+Parity with elasticdl/python/ps/parameters.py:30-224 and the Go model store
+(go/pkg/ps/model.go:25-110), with the embedding rows living in the C++
+store (native/kernels.cc) rather than Python dicts.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.native.bindings import NativeEmbeddingTable
+from elasticdl_tpu.utils import tensor_codec
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def slot_table_name(layer_name, slot_name):
+    return "%s-%s" % (layer_name, slot_name)
+
+
+class Parameters:
+    def __init__(self):
+        self.version = 0
+        self.initialized = False
+        self.dense = {}             # name -> np.float32 array
+        self.embeddings = {}        # name -> NativeEmbeddingTable
+        self.embedding_infos = {}   # name -> info dict
+        self.slot_tables = {}       # slot table name -> NativeEmbeddingTable
+        self._lock = threading.Lock()
+
+    # -- init ---------------------------------------------------------------
+
+    def init_from_model_pb(self, model_pb):
+        """First worker push initializes the shard (reference
+        go/pkg/ps/server.go:209-221)."""
+        with self._lock:
+            if self.initialized:
+                return False
+            dense, embeddings, infos, version = tensor_codec.pb_to_model(
+                model_pb
+            )
+            for name, arr in dense.items():
+                self.dense[name] = np.array(arr, np.float32, copy=True)
+            self.set_embedding_infos(infos)
+            for name, (values, ids) in embeddings.items():
+                self.embeddings[name].set(ids, values)
+            self.version = max(self.version, version)
+            self.initialized = True
+            logger.info(
+                "parameters initialized: %d dense, %d embedding tables",
+                len(self.dense), len(self.embeddings),
+            )
+            return True
+
+    def set_embedding_infos(self, infos):
+        for info in infos:
+            name = info["name"]
+            if name in self.embeddings:
+                continue
+            self.embedding_infos[name] = info
+            initializer = info.get("initializer", "uniform")
+            kwargs = {}
+            if initializer.startswith("constant("):
+                kwargs = {"init_a": float(initializer[9:-1])}
+                initializer = "constant"
+            elif initializer == "uniform":
+                kwargs = {"init_a": -0.05, "init_b": 0.05}
+            elif initializer == "normal":
+                kwargs = {"init_a": 0.0, "init_b": 0.05}
+            self.embeddings[name] = NativeEmbeddingTable(
+                info["dim"], initializer, seed=hash(name) & 0xFFFF,
+                **kwargs,
+            )
+
+    def create_slot_tables(self, slot_names):
+        """Per-slot shadow tables (reference
+        python/ps/parameters.py:169-183): zeros-initialized, same dim."""
+        for name, table in self.embeddings.items():
+            for slot in slot_names:
+                key = slot_table_name(name, slot)
+                if key not in self.slot_tables:
+                    self.slot_tables[key] = NativeEmbeddingTable(
+                        table.dim, "zeros"
+                    )
+
+    # -- access -------------------------------------------------------------
+
+    def get_dense(self):
+        return self.dense
+
+    def pull_embedding_vectors(self, name, ids):
+        return self.embeddings[name].get(ids)
+
+    def to_checkpoint_payload(self):
+        dense = {k: v.copy() for k, v in self.dense.items()}
+        embeddings = {}
+        for name, table in self.embeddings.items():
+            ids, values = table.export()
+            embeddings[name] = (ids, values)
+        for name, table in self.slot_tables.items():
+            ids, values = table.export()
+            embeddings["slot:" + name] = (ids, values)
+        return dense, embeddings
+
+    def restore_from_checkpoint_payload(self, dense, embeddings, infos):
+        for name, arr in dense.items():
+            self.dense[name] = np.array(arr, np.float32, copy=True)
+        self.set_embedding_infos(infos)
+        for name, (ids, values) in embeddings.items():
+            if name.startswith("slot:"):
+                continue
+            if name in self.embeddings and len(ids):
+                self.embeddings[name].set(ids, values)
+        self.initialized = bool(self.dense) or bool(self.embeddings)

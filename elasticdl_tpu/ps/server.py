@@ -1,0 +1,110 @@
+"""Parameter-server process (parity:
+elasticdl/python/ps/parameter_server.py:35-161,
+go/cmd/elasticdl_ps/main.go:27-74)."""
+
+import signal
+import threading
+
+from elasticdl_tpu.proto import rpc
+from elasticdl_tpu.ps.optimizer import create_optimizer
+from elasticdl_tpu.ps.parameters import Parameters
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.utils.args import parse_ps_args
+from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ParameterServer:
+    def __init__(self, args, master_client=None):
+        self.args = args
+        self.parameters = Parameters()
+        self.optimizer = create_optimizer(args.opt_type, args.opt_args)
+        saver = None
+        if args.checkpoint_dir:
+            saver = CheckpointSaver(
+                args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+            )
+        self.servicer = PserverServicer(
+            self.parameters,
+            self.optimizer,
+            ps_id=args.ps_id,
+            num_ps=args.num_ps,
+            use_async=args.use_async,
+            grads_to_wait=args.grads_to_wait,
+            sync_version_tolerance=args.sync_version_tolerance,
+            lr_staleness_modulation=args.lr_staleness_modulation,
+            checkpoint_saver=saver,
+            checkpoint_steps=args.checkpoint_steps,
+            evaluation_steps=args.evaluation_steps,
+            master_client=master_client,
+        )
+        self._server = None
+        self.port = None
+        self._done = threading.Event()
+        if args.checkpoint_dir_for_init:
+            self._restore(args.checkpoint_dir_for_init)
+
+    def _restore(self, ckpt_dir):
+        """Restore this shard by re-hash-routing the stored version
+        (reference go/pkg/ps/checkpoint.go:98-133)."""
+        saver = CheckpointSaver(ckpt_dir)
+        try:
+            dense, embeddings, version = saver.load_shard(
+                None, self.args.ps_id, self.args.num_ps
+            )
+        except FileNotFoundError:
+            logger.warning("no checkpoint to restore in %s", ckpt_dir)
+            return
+        infos = [
+            {"name": n, "dim": v[1].shape[1]}
+            for n, v in embeddings.items()
+            if not n.startswith("slot:") and len(v[1])
+        ]
+        self.parameters.restore_from_checkpoint_payload(
+            dense, embeddings, infos
+        )
+        self.parameters.version = version
+        logger.info("restored PS shard %d from version %d",
+                    self.args.ps_id, version)
+
+    def prepare(self):
+        self._server = grpc_utils.build_server(max_workers=64)
+        rpc.add_pserver_servicer(self.servicer, self._server)
+        self.port = self._server.add_insecure_port(
+            "[::]:%d" % self.args.port
+        )
+        self._server.start()
+        logger.info("PS %d/%d listening on port %d",
+                    self.args.ps_id, self.args.num_ps, self.port)
+
+    def run(self):
+        self._done.wait()
+        self.stop()
+
+    def stop(self):
+        self._done.set()
+        if self._server is not None:
+            self._server.stop(grace=1)
+            self._server = None
+
+
+def main(argv=None):
+    args = parse_ps_args(argv)
+    master_client = None
+    if args.master_addr:
+        from elasticdl_tpu.worker.master_client import MasterClient
+
+        channel = grpc_utils.build_channel(args.master_addr)
+        master_client = MasterClient(channel, worker_id=-1)
+    ps = ParameterServer(args, master_client=master_client)
+    ps.prepare()
+    signal.signal(signal.SIGTERM, lambda *a: ps.stop())
+    ps.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,192 @@
+"""PServer gRPC servicer — async and sync SGD semantics.
+
+Parity with elasticdl/python/ps/servicer.py:33-290 and
+go/pkg/ps/server.go:54-253:
+
+ - async: every gradient push applies immediately, version++ per push,
+   optional staleness-modulated learning rate (1/staleness)
+ - sync: buffer pushes until ``grads_to_wait``; average dense, concatenate
+   sparse; reject pushes whose model version lags beyond
+   ``sync_version_tolerance`` (worker re-pulls and retries the minibatch)
+ - checkpoint every ``checkpoint_steps`` versions; report version to the
+   master every ``evaluation_steps`` versions
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import tensor_codec
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PserverServicer:
+    def __init__(
+        self,
+        parameters,
+        optimizer,
+        ps_id=0,
+        num_ps=1,
+        use_async=True,
+        grads_to_wait=1,
+        sync_version_tolerance=0,
+        lr_staleness_modulation=False,
+        checkpoint_saver=None,
+        checkpoint_steps=0,
+        evaluation_steps=0,
+        master_client=None,
+    ):
+        self._params = parameters
+        self._opt = optimizer
+        self._ps_id = ps_id
+        self._num_ps = num_ps
+        self._use_async = use_async
+        self._grads_to_wait = grads_to_wait
+        self._sync_version_tolerance = sync_version_tolerance
+        self._lr_staleness_modulation = lr_staleness_modulation
+        self._checkpoint_saver = checkpoint_saver
+        self._checkpoint_steps = checkpoint_steps
+        self._evaluation_steps = evaluation_steps
+        self._master_client = master_client
+        self._lock = threading.Lock()
+        self._grad_buffer = []   # [(dense, embeddings)] awaiting sync apply
+
+    # -- RPCs ---------------------------------------------------------------
+
+    def push_model(self, request, _context=None):
+        self._params.init_from_model_pb(request)
+        self._params.create_slot_tables(self._opt.slot_names)
+        return pb.Empty()
+
+    def push_embedding_table_infos(self, request, _context=None):
+        _, _, infos, _ = tensor_codec.pb_to_model(request)
+        with self._lock:
+            self._params.set_embedding_infos(infos)
+            self._params.create_slot_tables(self._opt.slot_names)
+        return pb.Empty()
+
+    def pull_dense_parameters(self, request, _context=None):
+        res = pb.PullDenseParametersResponse()
+        res.initialized = self._params.initialized
+        res.version = self._params.version
+        if self._params.initialized and (
+            request.version < self._params.version or request.version < 0
+        ):
+            for name, arr in self._params.get_dense().items():
+                tensor_codec.ndarray_to_pb(
+                    arr, out=res.dense_parameters[name]
+                )
+        return res
+
+    def pull_embedding_vectors(self, request, _context=None):
+        vectors = self._params.pull_embedding_vectors(
+            request.name, np.asarray(request.ids, np.int64)
+        )
+        return tensor_codec.ndarray_to_pb(vectors)
+
+    def push_gradients(self, request, _context=None):
+        dense, embeddings, _, grad_version = tensor_codec.pb_to_model(
+            request.gradients
+        )
+        lr_override = request.learning_rate or None
+        with self._lock:
+            if self._use_async:
+                lr_mult = 1.0
+                if self._lr_staleness_modulation:
+                    staleness = max(
+                        1, self._params.version - grad_version
+                    )
+                    lr_mult = 1.0 / staleness
+                self._apply(dense, embeddings, lr_mult, lr_override)
+                self._params.version += 1
+                version = self._params.version
+                self._post_update()
+                return pb.PushGradientsResponse(
+                    accepted=True, version=version
+                )
+            # sync mode
+            if grad_version < (
+                self._params.version - self._sync_version_tolerance
+            ):
+                return pb.PushGradientsResponse(
+                    accepted=False, version=self._params.version
+                )
+            self._grad_buffer.append((dense, embeddings))
+            if len(self._grad_buffer) < self._grads_to_wait:
+                return pb.PushGradientsResponse(
+                    accepted=True, version=self._params.version
+                )
+            dense_sum, emb_cat = self._reduce_buffer()
+            self._grad_buffer.clear()
+            self._apply(dense_sum, emb_cat, 1.0, lr_override)
+            self._params.version += 1
+            version = self._params.version
+            self._post_update()
+            return pb.PushGradientsResponse(accepted=True, version=version)
+
+    # -- internals ----------------------------------------------------------
+
+    def _reduce_buffer(self):
+        """Average dense grads; concatenate sparse grads (summing happens
+        per-id inside the kernels after a merge)."""
+        n = len(self._grad_buffer)
+        dense_sum = {}
+        emb_cat = {}
+        for dense, embeddings in self._grad_buffer:
+            for name, g in dense.items():
+                if name in dense_sum:
+                    dense_sum[name] = dense_sum[name] + g
+                else:
+                    dense_sum[name] = np.array(g, np.float32)
+            for name, (values, ids) in embeddings.items():
+                if name in emb_cat:
+                    pv, pi = emb_cat[name]
+                    emb_cat[name] = (
+                        np.concatenate([pv, values]),
+                        np.concatenate([pi, ids]),
+                    )
+                else:
+                    emb_cat[name] = (np.asarray(values), np.asarray(ids))
+        for name in dense_sum:
+            dense_sum[name] = dense_sum[name] / n
+        merged = {
+            name: tensor_codec.merge_indexed_slices(values, ids)
+            for name, (values, ids) in emb_cat.items()
+        }
+        return dense_sum, merged
+
+    def _apply(self, dense, embeddings, lr_mult, lr_override):
+        emb = {}
+        for name, (values, ids) in embeddings.items():
+            values, ids = tensor_codec.merge_indexed_slices(values, ids)
+            emb[name] = (values, ids)
+        if lr_override:
+            lr_mult = lr_mult * (lr_override / self._opt.learning_rate)
+        self._opt.apply_gradients(
+            self._params, dense, emb, lr_multiplier=lr_mult
+        )
+
+    def _post_update(self):
+        v = self._params.version
+        if (
+            self._checkpoint_saver is not None
+            and self._checkpoint_steps
+            and v % self._checkpoint_steps == 0
+        ):
+            dense, embeddings = self._params.to_checkpoint_payload()
+            self._checkpoint_saver.save_shard(
+                v, self._ps_id, self._num_ps,
+                dense=dense, embeddings=embeddings,
+            )
+        if (
+            self._master_client is not None
+            and self._evaluation_steps
+            and v % self._evaluation_steps == 0
+        ):
+            try:
+                self._master_client.report_version(v)
+            except Exception as e:  # noqa: BLE001 — master may be gone
+                logger.warning("report_version failed: %s", e)

@@ -53,6 +53,22 @@ def build_worker(args):
         saver = CheckpointSaver(
             args.checkpoint_dir, keep_max=args.keep_checkpoint_max
         )
+    if args.distribution_strategy == "ps":
+        from elasticdl_tpu.worker.ps_client import build_ps_client
+        from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+        ps_client = build_ps_client(args.ps_addrs)
+        trainer = ParameterServerTrainer(
+            spec, ps_client,
+            batch_size=args.batch_size,
+            master_client=mc,
+            rng_seed=args.seed,
+        )
+        return Worker(
+            mc, reader, spec, trainer,
+            batch_size=args.batch_size,
+            log_loss_steps=args.log_loss_steps,
+        )
     mesh = None
     if args.distribution_strategy == "collective":
         # Shard the batch over every device this process sees (a TPU

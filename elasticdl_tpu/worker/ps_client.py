@@ -1,0 +1,145 @@
+"""Worker-side parameter-server client.
+
+Parity with elasticdl/python/worker/ps_client.py:37-301: dense params route
+to a PS shard by name hash, embedding ids by ``id % N``; pulls/pushes fan
+out to all shards as concurrent gRPC futures; duplicate embedding ids are
+merged before pushing.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.proto.rpc import PServerStub
+from elasticdl_tpu.utils import grpc_utils, hashing, tensor_codec
+
+
+def build_ps_client(ps_addrs):
+    """ps_addrs: comma-separated or list of host:port."""
+    if isinstance(ps_addrs, str):
+        ps_addrs = [a for a in ps_addrs.split(",") if a]
+    channels = []
+    for addr in ps_addrs:
+        channel = grpc_utils.build_channel(addr)
+        grpc_utils.wait_for_channel_ready(channel)
+        channels.append(channel)
+    return PSClient(channels)
+
+
+class PSClient:
+    def __init__(self, channels):
+        self._stubs = [PServerStub(c) for c in channels]
+        self.num_ps = len(self._stubs)
+
+    # -- partitioning -------------------------------------------------------
+
+    def partition_dense(self, names):
+        buckets = [[] for _ in range(self.num_ps)]
+        for name in names:
+            buckets[hashing.string_to_id(name, self.num_ps)].append(name)
+        return buckets
+
+    # -- model init ---------------------------------------------------------
+
+    def push_model(self, dense, embedding_infos=None, version=0):
+        buckets = self.partition_dense(dense.keys())
+        futures = []
+        for shard, names in enumerate(buckets):
+            model = tensor_codec.model_to_pb(
+                dense={n: dense[n] for n in names},
+                infos=embedding_infos or [],
+                version=version,
+            )
+            futures.append(self._stubs[shard].push_model.future(model))
+        for f in futures:
+            f.result()
+
+    def push_embedding_table_infos(self, infos):
+        model = tensor_codec.model_to_pb(infos=infos)
+        futures = [
+            stub.push_embedding_table_infos.future(model)
+            for stub in self._stubs
+        ]
+        for f in futures:
+            f.result()
+
+    # -- dense --------------------------------------------------------------
+
+    def pull_dense_parameters(self, version=-1):
+        """Returns (initialized, server_version, {name: array})."""
+        req = pb.PullDenseParametersRequest(version=version)
+        futures = [
+            stub.pull_dense_parameters.future(req) for stub in self._stubs
+        ]
+        dense = {}
+        initialized = True
+        server_version = 0
+        for f in futures:
+            res = f.result()
+            initialized = initialized and res.initialized
+            server_version = max(server_version, res.version)
+            for name, t in res.dense_parameters.items():
+                dense[name] = tensor_codec.pb_to_ndarray(t)
+        return initialized, server_version, dense
+
+    # -- embeddings ---------------------------------------------------------
+
+    def pull_embedding_vectors(self, name, ids):
+        """ids: int64 [n]; returns [n, dim] rows in input order."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size == 0:
+            return np.zeros((0, 0), np.float32)
+        buckets = hashing.scatter_ids(ids, self.num_ps)
+        futures = {}
+        for shard, positions in buckets.items():
+            req = pb.PullEmbeddingVectorsRequest(name=name)
+            req.ids.extend(int(ids[p]) for p in positions)
+            futures[shard] = (
+                positions, self._stubs[shard].pull_embedding_vectors.future(req)
+            )
+        out = None
+        for shard, (positions, future) in futures.items():
+            rows = tensor_codec.pb_to_ndarray(future.result())
+            if out is None:
+                out = np.empty((ids.size, rows.shape[1]), np.float32)
+            out[np.asarray(positions)] = rows
+        return out
+
+    # -- gradients ----------------------------------------------------------
+
+    def push_gradients(self, dense_grads, embedding_grads=None,
+                       version=0, learning_rate=0.0):
+        """dense_grads: {name: array}; embedding_grads:
+        {table: (values [n, dim], ids [n])}.  Returns (accepted,
+        max_server_version)."""
+        embedding_grads = embedding_grads or {}
+        shard_dense = [dict() for _ in range(self.num_ps)]
+        for name, g in dense_grads.items():
+            shard_dense[hashing.string_to_id(name, self.num_ps)][name] = g
+        shard_emb = [dict() for _ in range(self.num_ps)]
+        for table, (values, ids) in embedding_grads.items():
+            values, ids = tensor_codec.merge_indexed_slices(values, ids)
+            owners = np.asarray(ids) % self.num_ps
+            for shard in range(self.num_ps):
+                sel = owners == shard
+                if sel.any():
+                    shard_emb[shard][table] = (values[sel], ids[sel])
+        futures = []
+        for shard in range(self.num_ps):
+            if not shard_dense[shard] and not shard_emb[shard]:
+                continue
+            model = tensor_codec.model_to_pb(
+                dense=shard_dense[shard],
+                embeddings=shard_emb[shard],
+                version=version,
+            )
+            req = pb.PushGradientsRequest(
+                gradients=model, learning_rate=learning_rate
+            )
+            futures.append(self._stubs[shard].push_gradients.future(req))
+        accepted = True
+        max_version = 0
+        for f in futures:
+            res = f.result()
+            accepted = accepted and res.accepted
+            max_version = max(max_version, res.version)
+        return accepted, max_version

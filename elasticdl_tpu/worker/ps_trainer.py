@@ -1,0 +1,243 @@
+"""Parameter-server trainer — the sparse/large-embedding path.
+
+Parity with elasticdl/python/worker/ps_trainer.py:36-440, redesigned for
+XLA.  The reference routes embedding lookups through ``tf.py_function``
+inside the graph (embedding_delegate.py:74-106); here the jitted step stays
+*pure*: embedding rows are pulled from the PS on the host, passed into the
+step as regular inputs, and the step returns gradients w.r.t. those inputs
+(the reference's "BET" tape trick, done the functional way).  Static shapes
+everywhere: the unique-id list is padded to the batch's id count, so one
+compilation serves every batch.
+
+Step shape:
+  1. every ``get_model_steps``: pull dense params from PS (push-to-init on
+     first contact, ps_trainer.py:160-177 semantics)
+  2. host: collect per-table ids, unique+pad, pull rows -> [U, dim]
+  3. device: jitted value_and_grad over (params, emb_rows)
+  4. host: push dense grads + per-table (grad_rows[:n_unique], ids) to PS
+  5. a rejected push (sync mode staleness) raises -> the worker's
+     minibatch retry loop re-pulls and retries
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.pytree import (
+    flatten_with_names,
+    to_numpy,
+    unflatten_from_names,
+)
+from elasticdl_tpu.utils.timing import Timing
+from elasticdl_tpu.worker.collective_trainer import _pad_batch
+from elasticdl_tpu.worker.trainer import Trainer
+
+logger = get_logger(__name__)
+
+IDS_KEY = "__ids__"
+
+
+class GradientsRejected(RuntimeError):
+    """Sync-mode PS rejected a stale push; re-pull and retry."""
+
+
+class ParameterServerTrainer(Trainer):
+    def __init__(
+        self,
+        spec,
+        ps_client,
+        batch_size,
+        master_client=None,
+        get_model_steps=1,
+        rng_seed=0,
+        learning_rate=0.0,
+    ):
+        self._spec = spec
+        self._ps = ps_client
+        self._batch_size = batch_size
+        self._mc = master_client
+        self._get_model_steps = get_model_steps
+        self._learning_rate = learning_rate
+        self.timing = Timing(logger=logger)
+
+        self._params = spec.init_fn(jax.random.PRNGKey(rng_seed))
+        self._emb_dims = {
+            info["name"]: info["dim"]
+            for info in spec.ps_embedding_infos
+        }
+        self._version = 0
+        self._steps = 0
+        self._grad_step = None
+        self._eval_step = None
+        self._push_model_to_init()
+
+    # -- PS interaction -----------------------------------------------------
+
+    def _push_model_to_init(self):
+        """First contact: initialize the PS shards from the local init
+        (reference server.go:209-221 push-to-init)."""
+        initialized, version, dense = self._ps.pull_dense_parameters(-1)
+        if not initialized:
+            named, _ = flatten_with_names(to_numpy(self._params))
+            self._ps.push_model(
+                named, embedding_infos=self._spec.ps_embedding_infos
+            )
+            initialized, version, dense = self._ps.pull_dense_parameters(-1)
+        if dense:
+            self._params = unflatten_from_names(
+                to_numpy(self._params), dense
+            )
+        self._version = version
+
+    def _pull_dense(self):
+        with self.timing.timeit("get_model"):
+            initialized, version, dense = self._ps.pull_dense_parameters(
+                self._version
+            )
+            if not initialized:
+                raise GradientsRejected(
+                    "PS lost its state (restarted?); re-initializing"
+                )
+            if dense:
+                self._params = unflatten_from_names(
+                    to_numpy(self._params), dense
+                )
+            self._version = version
+
+    # -- embedding plumbing -------------------------------------------------
+
+    def _prepare_embeddings(self, features):
+        """Extract ids, pull rows, return (clean_features, emb_inputs,
+        push_info)."""
+        if not isinstance(features, dict) or IDS_KEY not in features:
+            return features, {}, {}
+        features = dict(features)
+        ids_map = features.pop(IDS_KEY)
+        emb_inputs = {}
+        push_info = {}
+        for table, ids in ids_map.items():
+            ids = np.asarray(ids, dtype=np.int64)
+            flat = ids.reshape(-1)
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            n_uniq = uniq.size
+            # pad the unique list to the flat id count for static shapes
+            padded = np.full(flat.size, uniq[0] if n_uniq else 0, np.int64)
+            padded[:n_uniq] = uniq
+            with self.timing.timeit("pull_embedding"):
+                rows = self._ps.pull_embedding_vectors(table, padded)
+            features["idx__" + table] = inverse.reshape(ids.shape).astype(
+                np.int32
+            )
+            emb_inputs[table] = rows.astype(np.float32)
+            push_info[table] = (padded, n_uniq)
+        return features, emb_inputs, push_info
+
+    # -- jitted steps -------------------------------------------------------
+
+    def _build_grad_step(self):
+        apply_fn = self._spec.apply_fn
+        loss_fn = self._spec.loss_fn
+
+        @jax.jit
+        def grad_step(params, emb_inputs, features, labels, weights):
+            def f(params, emb_inputs):
+                feats = dict(features) if isinstance(features, dict) else (
+                    features
+                )
+                if emb_inputs:
+                    feats = dict(feats)
+                    for table, rows in emb_inputs.items():
+                        feats["emb__" + table] = rows
+                out = apply_fn(params, feats, True)
+                per_example = loss_fn(out, labels).astype(jnp.float32)
+                per_example = per_example.reshape(
+                    per_example.shape[0], -1
+                ).mean(axis=-1)
+                return jnp.sum(per_example * weights) / jnp.maximum(
+                    jnp.sum(weights), 1.0
+                )
+
+            loss, (param_grads, emb_grads) = jax.value_and_grad(
+                f, argnums=(0, 1)
+            )(params, emb_inputs)
+            return loss, param_grads, emb_grads
+
+        return grad_step
+
+    def _build_eval_step(self):
+        apply_fn = self._spec.apply_fn
+
+        @jax.jit
+        def eval_step(params, emb_inputs, features):
+            feats = features
+            if emb_inputs:
+                feats = dict(features)
+                for table, rows in emb_inputs.items():
+                    feats["emb__" + table] = rows
+            return apply_fn(params, feats, False)
+
+        return eval_step
+
+    # -- Trainer API --------------------------------------------------------
+
+    def train_minibatch(self, features, labels):
+        if self._steps % self._get_model_steps == 0:
+            self._pull_dense()
+        # Pad BEFORE preparing embeddings so id-array shapes are static
+        # across partial batches (padding rows look up id 0 with weight 0).
+        (features, labels), weights = _pad_batch(
+            (features, labels), self._batch_size
+        )
+        features, emb_inputs, push_info = self._prepare_embeddings(features)
+        if self._grad_step is None:
+            self._grad_step = self._build_grad_step()
+        with self.timing.timeit("batch_process"):
+            loss, param_grads, emb_grads = self._grad_step(
+                self._params, emb_inputs, features, labels, weights
+            )
+        with self.timing.timeit("report_gradient"):
+            named_grads, _ = flatten_with_names(to_numpy(param_grads))
+            emb_push = {}
+            for table, (padded_ids, n_uniq) in push_info.items():
+                rows = np.asarray(emb_grads[table])[:n_uniq]
+                emb_push[table] = (rows, padded_ids[:n_uniq])
+            accepted, version = self._ps.push_gradients(
+                named_grads, emb_push,
+                version=self._version,
+                learning_rate=self._learning_rate,
+            )
+        if not accepted:
+            self._pull_dense()
+            raise GradientsRejected(
+                "stale gradients at version %d" % self._version
+            )
+        self._version = max(self._version, version)
+        self._steps += 1
+        return float(loss), self._version
+
+    def evaluate_minibatch(self, features, labels):
+        n = jax.tree_util.tree_leaves(features)[0].shape[0]
+        (features, labels), _ = _pad_batch(
+            (features, labels), self._batch_size
+        )
+        features, emb_inputs, _ = self._prepare_embeddings(features)
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        outputs = self._eval_step(self._params, emb_inputs, features)
+        return np.asarray(outputs)[:n], np.asarray(labels)[:n]
+
+    def predict_minibatch(self, features):
+        outputs, _ = self.evaluate_minibatch(
+            features, np.zeros((jax.tree_util.tree_leaves(features)[0]
+                                .shape[0],), np.int32)
+        )
+        return outputs
+
+    @property
+    def version(self):
+        return self._version
+
+    def export_parameters(self):
+        named, _ = flatten_with_names(to_numpy(self._params))
+        return named

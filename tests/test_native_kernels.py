@@ -1,0 +1,110 @@
+"""Native kernel parity vs golden numpy updates (reference pattern:
+go/pkg/kernel/kernel_test.go:25-182)."""
+
+import numpy as np
+import pytest
+
+nb = pytest.importorskip("elasticdl_tpu.native.bindings")
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    p = rng.randn(100).astype(np.float32)
+    g = rng.randn(100).astype(np.float32)
+    expect = p - 0.1 * g
+    nb.sgd(p, g, 0.1)
+    np.testing.assert_allclose(p, expect, rtol=1e-6)
+
+
+def test_momentum_matches_numpy():
+    rng = np.random.RandomState(1)
+    p = rng.randn(50).astype(np.float32)
+    g = rng.randn(50).astype(np.float32)
+    vel = np.zeros(50, np.float32)
+    p0 = p.copy()
+    nb.momentum(p, g, vel, lr=0.1, mu=0.9)
+    np.testing.assert_allclose(vel, g, rtol=1e-6)
+    np.testing.assert_allclose(p, p0 - 0.1 * g, rtol=1e-6)
+    # second step accumulates velocity
+    p1 = p.copy()
+    nb.momentum(p, g, vel, lr=0.1, mu=0.9)
+    np.testing.assert_allclose(vel, 0.9 * g + g, rtol=1e-6)
+    np.testing.assert_allclose(p, p1 - 0.1 * (0.9 * g + g), rtol=1e-5)
+
+
+def test_adam_bias_correction_matches_numpy():
+    rng = np.random.RandomState(2)
+    p = rng.randn(64).astype(np.float32)
+    g = rng.randn(64).astype(np.float32)
+    m = np.zeros(64, np.float32)
+    v = np.zeros(64, np.float32)
+    p_ref, m_ref, v_ref = p.copy(), m.copy(), v.copy()
+    lr, b1, b2, eps = 0.001, 0.9, 0.999, 1e-8
+    for step in range(1, 4):
+        nb.adam(p, g, m, v, lr, step, b1, b2, eps)
+        m_ref = b1 * m_ref + (1 - b1) * g
+        v_ref = b2 * v_ref + (1 - b2) * g * g
+        alpha = lr * np.sqrt(1 - b2 ** step) / (1 - b1 ** step)
+        p_ref = p_ref - alpha * m_ref / (np.sqrt(v_ref) + eps)
+    np.testing.assert_allclose(p, p_ref, rtol=1e-5)
+
+
+def test_adam_amsgrad():
+    p = np.ones(4, np.float32)
+    m = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    maxsq = np.zeros(4, np.float32)
+    g1 = np.full(4, 2.0, np.float32)
+    g2 = np.full(4, 0.01, np.float32)
+    nb.adam(p, g1, m, v, 0.01, 1, max_square=maxsq)
+    v_after_1 = v.copy()
+    nb.adam(p, g2, m, v, 0.01, 2, max_square=maxsq)
+    # max_square holds the peak v, not the decayed one
+    np.testing.assert_allclose(maxsq, v_after_1, rtol=1e-6)
+    assert (v < maxsq).all()
+
+
+def test_adagrad_matches_numpy():
+    p = np.ones(8, np.float32)
+    g = np.full(8, 0.5, np.float32)
+    accum = np.zeros(8, np.float32)
+    nb.adagrad(p, g, accum, lr=0.1)
+    np.testing.assert_allclose(accum, 0.25, rtol=1e-6)
+    np.testing.assert_allclose(p, 1 - 0.1 * 0.5 / (0.5 + 1e-8),
+                               rtol=1e-5)
+
+
+def test_table_lazy_init_deterministic():
+    t1 = nb.NativeEmbeddingTable(4, "uniform", seed=42)
+    t2 = nb.NativeEmbeddingTable(4, "uniform", seed=42)
+    np.testing.assert_array_equal(t1.get([3, 7]), t2.get([7, 3])[::-1])
+    assert len(t1) == 2
+    bounds = t1.get([99])
+    assert (bounds >= -0.05).all() and (bounds <= 0.05).all()
+
+
+def test_table_set_get_export():
+    t = nb.NativeEmbeddingTable(3, "zeros")
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t.set([10, 20], vals)
+    np.testing.assert_array_equal(t.get([20, 10]), vals[::-1])
+    ids, values = t.export()
+    order = np.argsort(ids)
+    np.testing.assert_array_equal(ids[order], [10, 20])
+    np.testing.assert_array_equal(values[order], vals)
+
+
+def test_table_sparse_adam_matches_dense_adam():
+    t = nb.NativeEmbeddingTable(4, "zeros")
+    m_t = nb.NativeEmbeddingTable(4, "zeros")
+    v_t = nb.NativeEmbeddingTable(4, "zeros")
+    row0 = np.random.RandomState(3).randn(1, 4).astype(np.float32)
+    t.set([5], row0)
+    g = np.full((1, 4), 0.3, np.float32)
+    t.apply_adam([5], g, m_t, v_t, lr=0.01, step=1)
+
+    p = row0[0].copy()
+    m = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    nb.adam(p, g[0], m, v, 0.01, 1)
+    np.testing.assert_allclose(t.get([5])[0], p, rtol=1e-6)

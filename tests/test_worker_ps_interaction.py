@@ -1,0 +1,114 @@
+"""Worker <-> PS integration: DeepFM trains through real gRPC PS shards
+(reference pattern: worker_ps_interaction_test.py:203-356 incl. the PS
+restart fault-tolerance test)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.models import deepfm
+from elasticdl_tpu.utils import metrics
+from elasticdl_tpu.worker.ps_trainer import (
+    GradientsRejected,
+    ParameterServerTrainer,
+)
+from tests.test_pserver import start_ps, stop_all
+
+VOCAB = 1000
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    return deepfm.synthetic_data(n=512, vocab_size=VOCAB, seed=3)
+
+
+def batches(dataset, spec, batch_size=64):
+    dense, ids, labels = dataset
+    out = []
+    for i in range(0, len(labels), batch_size):
+        records = [
+            (dense[j], ids[j], labels[j])
+            for j in range(i, min(i + batch_size, len(labels)))
+        ]
+        out.append(spec.feed(records))
+    return out
+
+def test_deepfm_trains_through_ps(dataset):
+    spec = deepfm.model_spec(vocab_size=VOCAB, embedding_dim=4,
+                             hidden=(32,))
+    client, servicers, servers = start_ps(
+        num_ps=2, opt_type="adam", opt_args="learning_rate=0.01",
+        use_async=True,
+    )
+    try:
+        trainer = ParameterServerTrainer(
+            spec, client, batch_size=64, get_model_steps=1
+        )
+        data = batches(dataset, spec)
+        first_loss = None
+        for epoch in range(6):
+            for features, labels in data:
+                loss, version = trainer.train_minibatch(features, labels)
+                if first_loss is None:
+                    first_loss = loss
+        assert version > 0
+        assert loss < first_loss, (first_loss, loss)
+
+        auc = metrics.AUC()
+        for features, labels in data:
+            outputs, labels = trainer.evaluate_minibatch(features, labels)
+            auc.update(1 / (1 + np.exp(-outputs)), labels)
+        assert auc.result() > 0.75, auc.result()
+    finally:
+        stop_all(servers)
+
+
+def test_sync_mode_rejection_retry_path(dataset):
+    """Two trainers against a sync PS with zero tolerance: a stale push
+    raises GradientsRejected and succeeds after re-pull."""
+    spec = deepfm.model_spec(vocab_size=VOCAB, embedding_dim=4,
+                             hidden=(16,))
+    client, servicers, servers = start_ps(
+        num_ps=1, opt_type="sgd", opt_args="learning_rate=0.01",
+        use_async=False, grads_to_wait=1, sync_version_tolerance=0,
+    )
+    try:
+        t1 = ParameterServerTrainer(spec, client, batch_size=64)
+        # t2 pulls only on its first step, so later steps can go stale
+        t2 = ParameterServerTrainer(spec, client, batch_size=64,
+                                    get_model_steps=100)
+        data = batches(dataset, spec)
+        t1.train_minibatch(*data[0])          # server -> version 1
+        t2.train_minibatch(*data[1])          # pulls v1, server -> v2
+        t1.train_minibatch(*data[2])          # pulls v2, server -> v3
+        with pytest.raises(GradientsRejected):
+            t2.train_minibatch(*data[3])      # pushes at v2 < v3: stale
+        # the raise triggered a re-pull; retry succeeds
+        loss, version = t2.train_minibatch(*data[3])
+        assert version == 4
+    finally:
+        stop_all(servers)
+
+
+def test_ps_restart_reinitialized_by_worker(dataset):
+    """Kill the PS mid-training; a fresh PS gets re-initialized by the
+    worker's push-to-init (reference test_restart_ps semantics)."""
+    spec = deepfm.model_spec(vocab_size=VOCAB, embedding_dim=4,
+                             hidden=(16,))
+    client, servicers, servers = start_ps(num_ps=1)
+    data = batches(dataset, spec)
+    trainer = ParameterServerTrainer(spec, client, batch_size=64)
+    trainer.train_minibatch(*data[0])
+    stop_all(servers)
+
+    # fresh PS on a new port; trainer gets a fresh client
+    client2, servicers2, servers2 = start_ps(num_ps=1)
+    try:
+        trainer._ps = client2
+        with pytest.raises(Exception):
+            # first contact fails: uninitialized PS rejects the pull
+            trainer.train_minibatch(*data[1])
+        trainer._push_model_to_init()
+        loss, version = trainer.train_minibatch(*data[1])
+        assert np.isfinite(loss)
+    finally:
+        stop_all(servers2)
