@@ -36,6 +36,7 @@ class PSManager:
         self._procs = {}
         self._relaunches = {}
         self._stopped = threading.Event()
+        self._lock = threading.Lock()
 
     @property
     def addrs(self):
@@ -67,12 +68,15 @@ class PSManager:
     def _launch(self, ps_id, restore=False):
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "elasticdl_tpu.ps.server"]
-            + self._args(ps_id, restore),
-            env=env,
-        )
-        self._procs[ps_id] = proc
+        with self._lock:
+            if self._stopped.is_set():
+                return
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "elasticdl_tpu.ps.server"]
+                + self._args(ps_id, restore),
+                env=env,
+            )
+            self._procs[ps_id] = proc
         logger.info("launched PS %d on port %d (restore=%s)",
                     ps_id, self.ports[ps_id], restore)
         threading.Thread(
@@ -99,7 +103,11 @@ class PSManager:
             self._launch(ps_id)
 
     def stop(self):
-        self._stopped.set()
-        for proc in self._procs.values():
+        # Flag first under the lock so no in-flight _watch relaunch can
+        # spawn an orphan after we start terminating.
+        with self._lock:
+            self._stopped.set()
+            procs = list(self._procs.values())
+        for proc in procs:
             if proc.poll() is None:
                 proc.terminate()

@@ -16,11 +16,16 @@ def build(force=False):
         and os.path.getmtime(LIB) >= os.path.getmtime(SRC)
     ):
         return LIB
+    # Compile to a process-private temp file and atomically rename:
+    # concurrent first imports (N PS processes + workers starting at once)
+    # must never dlopen a half-written .so.
+    tmp = LIB + ".tmp.%d" % os.getpid()
     cmd = [
         "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-        "-o", LIB, SRC,
+        "-o", tmp, SRC,
     ]
     subprocess.run(cmd, check=True)
+    os.replace(tmp, LIB)
     return LIB
 
 

@@ -104,13 +104,27 @@ class Parameters:
             embeddings["slot:" + name] = (ids, values)
         return dense, embeddings
 
-    def restore_from_checkpoint_payload(self, dense, embeddings, infos):
+    def restore_from_checkpoint_payload(self, dense, embeddings, infos,
+                                        slot_names=()):
         for name, arr in dense.items():
             self.dense[name] = np.array(arr, np.float32, copy=True)
         self.set_embedding_infos(infos)
         for name, (ids, values) in embeddings.items():
-            if name.startswith("slot:"):
+            if name.startswith("slot:") or not len(ids):
                 continue
-            if name in self.embeddings and len(ids):
+            if name in self.embeddings:
                 self.embeddings[name].set(ids, values)
+        # Recreate optimizer slot tables, then restore their saved rows —
+        # a relaunched shard must resume Adam/Momentum state, not crash on
+        # the first sparse push.
+        self.create_slot_tables(slot_names)
+        for name, (ids, values) in embeddings.items():
+            if not name.startswith("slot:") or not len(ids):
+                continue
+            key = name[len("slot:"):]
+            if key not in self.slot_tables:
+                self.slot_tables[key] = NativeEmbeddingTable(
+                    values.shape[1], "zeros"
+                )
+            self.slot_tables[key].set(ids, values)
         self.initialized = bool(self.dense) or bool(self.embeddings)

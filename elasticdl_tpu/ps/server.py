@@ -64,7 +64,8 @@ class ParameterServer:
             if not n.startswith("slot:") and len(v[1])
         ]
         self.parameters.restore_from_checkpoint_payload(
-            dense, embeddings, infos
+            dense, embeddings, infos,
+            slot_names=self.optimizer.slot_names,
         )
         self.parameters.version = version
         logger.info("restored PS shard %d from version %d",

@@ -70,21 +70,26 @@ class PserverServicer:
 
     def pull_dense_parameters(self, request, _context=None):
         res = pb.PullDenseParametersResponse()
-        res.initialized = self._params.initialized
-        res.version = self._params.version
-        if self._params.initialized and (
-            request.version < self._params.version or request.version < 0
-        ):
-            for name, arr in self._params.get_dense().items():
-                tensor_codec.ndarray_to_pb(
-                    arr, out=res.dense_parameters[name]
-                )
+        # Serialize against in-place kernel updates so pulls never see a
+        # half-applied parameter buffer.
+        with self._lock:
+            res.initialized = self._params.initialized
+            res.version = self._params.version
+            if self._params.initialized and (
+                request.version < self._params.version
+                or request.version < 0
+            ):
+                for name, arr in self._params.get_dense().items():
+                    tensor_codec.ndarray_to_pb(
+                        arr, out=res.dense_parameters[name]
+                    )
         return res
 
     def pull_embedding_vectors(self, request, _context=None):
-        vectors = self._params.pull_embedding_vectors(
-            request.name, np.asarray(request.ids, np.int64)
-        )
+        with self._lock:
+            vectors = self._params.pull_embedding_vectors(
+                request.name, np.asarray(request.ids, np.int64)
+            )
         return tensor_codec.ndarray_to_pb(vectors)
 
     def push_gradients(self, request, _context=None):

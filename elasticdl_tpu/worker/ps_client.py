@@ -110,7 +110,16 @@ class PSClient:
                        version=0, learning_rate=0.0):
         """dense_grads: {name: array}; embedding_grads:
         {table: (values [n, dim], ids [n])}.  Returns (accepted,
-        max_server_version)."""
+        max_server_version).
+
+        Known limitation (shared with the reference's per-shard sync
+        buffering): in sync mode with num_ps > 1 the fan-out is not
+        atomic — if one shard rejects a stale push while another accepts,
+        the retried minibatch is applied again on the accepting shard.
+        ``sync_version_tolerance`` already admits bounded staleness, and
+        the double-apply is within that bound, but jobs wanting strict
+        once-per-minibatch application should run one PS shard or async
+        mode."""
         embedding_grads = embedding_grads or {}
         shard_dense = [dict() for _ in range(self.num_ps)]
         for name, g in dense_grads.items():

@@ -96,9 +96,14 @@ class ParameterServerTrainer(Trainer):
                 self._version
             )
             if not initialized:
-                raise GradientsRejected(
-                    "PS lost its state (restarted?); re-initializing"
+                # A PS shard restarted without a restorable checkpoint:
+                # re-initialize it from the local model (reference
+                # test_restart_ps semantics) and continue training.
+                logger.warning(
+                    "PS uninitialized (restart?); re-pushing model"
                 )
+                self._push_model_to_init()
+                return
             if dense:
                 self._params = unflatten_from_names(
                     to_numpy(self._params), dense
@@ -121,16 +126,20 @@ class ParameterServerTrainer(Trainer):
             flat = ids.reshape(-1)
             uniq, inverse = np.unique(flat, return_inverse=True)
             n_uniq = uniq.size
-            # pad the unique list to the flat id count for static shapes
-            padded = np.full(flat.size, uniq[0] if n_uniq else 0, np.int64)
-            padded[:n_uniq] = uniq
+            # Pull only the unique rows; pad host-side to the flat id
+            # count so the jitted step sees one static shape per batch
+            # size without inflating the gRPC payload.
             with self.timing.timeit("pull_embedding"):
-                rows = self._ps.pull_embedding_vectors(table, padded)
+                rows = self._ps.pull_embedding_vectors(table, uniq)
+            padded_rows = np.zeros(
+                (flat.size, self._emb_dims[table]), np.float32
+            )
+            padded_rows[:n_uniq] = rows
             features["idx__" + table] = inverse.reshape(ids.shape).astype(
                 np.int32
             )
-            emb_inputs[table] = rows.astype(np.float32)
-            push_info[table] = (padded, n_uniq)
+            emb_inputs[table] = padded_rows
+            push_info[table] = (uniq, n_uniq)
         return features, emb_inputs, push_info
 
     # -- jitted steps -------------------------------------------------------
@@ -199,9 +208,9 @@ class ParameterServerTrainer(Trainer):
         with self.timing.timeit("report_gradient"):
             named_grads, _ = flatten_with_names(to_numpy(param_grads))
             emb_push = {}
-            for table, (padded_ids, n_uniq) in push_info.items():
+            for table, (uniq_ids, n_uniq) in push_info.items():
                 rows = np.asarray(emb_grads[table])[:n_uniq]
-                emb_push[table] = (rows, padded_ids[:n_uniq])
+                emb_push[table] = (rows, uniq_ids)
             accepted, version = self._ps.push_gradients(
                 named_grads, emb_push,
                 version=self._version,

@@ -104,11 +104,11 @@ def test_ps_restart_reinitialized_by_worker(dataset):
     client2, servicers2, servers2 = start_ps(num_ps=1)
     try:
         trainer._ps = client2
-        with pytest.raises(Exception):
-            # first contact fails: uninitialized PS rejects the pull
-            trainer.train_minibatch(*data[1])
-        trainer._push_model_to_init()
+        # the next pull detects the uninitialized PS and re-pushes the
+        # local model automatically — training continues without manual
+        # intervention
         loss, version = trainer.train_minibatch(*data[1])
         assert np.isfinite(loss)
+        assert servicers2[0]._params.initialized
     finally:
         stop_all(servers2)
