@@ -38,11 +38,7 @@ def build_master(args):
         eval_reader = create_data_reader(
             args.validation_data_origin, records_per_shard=records_per_task
         )
-    task_manager = TaskManager(
-        training_shards=reader.create_shards(),
-        evaluation_shards=(
-            eval_reader.create_shards() if eval_reader else None
-        ),
+    common = dict(
         records_per_task=records_per_task,
         num_epochs=args.num_epochs,
         shuffle=args.shuffle,
@@ -51,9 +47,34 @@ def build_master(args):
         task_timeout_secs=args.task_timeout_secs,
         seed=args.seed,
     )
+    if args.job_type == "predict":
+        task_manager = TaskManager(
+            prediction_shards=reader.create_shards(), **common
+        )
+    elif args.job_type == "evaluate":
+        task_manager = TaskManager(
+            evaluation_shards=reader.create_shards(), **common
+        )
+    else:
+        task_manager = TaskManager(
+            training_shards=reader.create_shards(),
+            evaluation_shards=(
+                eval_reader.create_shards() if eval_reader else None
+            ),
+            **common,
+        )
     spec = load_model_spec(args.model_zoo)
     evaluation_service = None
-    if (
+    if args.job_type == "evaluate":
+        if spec.eval_metrics_fn is None:
+            raise ValueError(
+                "evaluate job requires eval_metrics_fn in the model spec"
+            )
+        evaluation_service = EvaluationService(
+            task_manager, spec.eval_metrics_fn, evaluation_steps=1
+        )
+        evaluation_service.add_evaluation_task_if_needed(0)
+    elif (
         args.evaluation_steps
         and eval_reader is not None
         and spec.eval_metrics_fn is not None

@@ -1,0 +1,93 @@
+"""Training callbacks (parity:
+elasticdl/python/elasticdl/callbacks.py:23-109).
+
+``ModelExporter`` is the SavedModel-exporter equivalent: it runs on the one
+worker that receives the train-end callback task and writes a standalone
+export — a ``model.npz`` of merged parameters plus a JSON manifest — that
+inference code can load without the framework.  When a PS checkpoint dir is
+given, the latest PS-side state (incl. embedding tables) is merged in, the
+reference's checkpoint-merge export path (model_handler.py:242-269).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ModelExporter:
+    def __init__(self, export_dir, checkpoint_dir=None, model_name=""):
+        self.export_dir = export_dir
+        self.checkpoint_dir = checkpoint_dir
+        self.model_name = model_name
+
+    def on_train_end(self, trainer):
+        os.makedirs(self.export_dir, exist_ok=True)
+        payload = dict(trainer.export_parameters())
+        embeddings = {}
+        if self.checkpoint_dir:
+            from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+            saver = CheckpointSaver(self.checkpoint_dir)
+            try:
+                ckpt_dense, ckpt_emb, version = saver.load()
+                payload.update(ckpt_dense)
+                for name, (ids, values) in ckpt_emb.items():
+                    if name.startswith("slot:"):
+                        continue  # optimizer state is not part of the model
+                    embeddings["emb_ids/" + name] = ids
+                    embeddings["emb_vals/" + name] = values
+            except FileNotFoundError:
+                logger.warning("no checkpoint to merge for export")
+        path = os.path.join(self.export_dir, "model.npz")
+        with open(path, "wb") as f:
+            np.savez(f, **payload, **embeddings)
+        manifest = {
+            "model_name": self.model_name,
+            "format": "elasticdl_tpu_export_v1",
+            "parameters": sorted(payload),
+            "embedding_tables": sorted(
+                n[len("emb_ids/"):] for n in embeddings
+                if n.startswith("emb_ids/")
+            ),
+            "version": getattr(trainer, "version", 0),
+        }
+        with open(os.path.join(self.export_dir, "manifest.json"),
+                  "w") as f:
+            json.dump(manifest, f, indent=2)
+        logger.info("exported model to %s (%d tensors)",
+                    self.export_dir, len(payload))
+
+
+def load_export(export_dir):
+    """Load an export back into ({name: array}, {table: (ids, values)})."""
+    dense = {}
+    embeddings = {}
+    with np.load(os.path.join(export_dir, "model.npz")) as z:
+        for key in z.files:
+            if key.startswith("emb_ids/"):
+                name = key[len("emb_ids/"):]
+                embeddings[name] = (z[key], z["emb_vals/" + name])
+            elif not key.startswith("emb_vals/"):
+                dense[key] = z[key]
+    return dense, embeddings
+
+
+class LearningRateScheduler:
+    """Schedule the learning rate by model version (parity:
+    callbacks.py:69-109).  For the PS path the scheduled lr rides the
+    push_gradients message; for collective training prefer an optax
+    schedule baked into the optimizer."""
+
+    def __init__(self, schedule_fn):
+        self.schedule_fn = schedule_fn
+
+    def on_train_batch_begin(self, trainer):
+        lr = float(self.schedule_fn(getattr(trainer, "version", 0)))
+        if hasattr(trainer, "_learning_rate"):
+            trainer._learning_rate = lr
+        return lr

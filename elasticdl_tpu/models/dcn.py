@@ -1,0 +1,103 @@
+"""Deep & Cross Network (DCN) — dac_ctr zoo parity (reference
+model_zoo/dac_ctr includes DCN alongside DeepFM/xDeepFM/wide-deep).
+
+Same PS feature convention as deepfm.py: one shared factor table served by
+the parameter server; cross layers run on-device inside the jitted step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.models import deepfm as _ctr
+from elasticdl_tpu.utils import metrics
+
+EMB_TABLE = "dcn_embedding"
+
+
+def init_params(rng, num_dense, num_fields, embedding_dim,
+                num_cross_layers=3, hidden=(128, 64)):
+    d0 = num_fields * embedding_dim + num_dense
+    keys = jax.random.split(rng, num_cross_layers + len(hidden) + 2)
+    params = {}
+    for i in range(num_cross_layers):
+        params["cross_w%d" % i] = (
+            jax.random.normal(keys[i], (d0,)) * (1.0 / np.sqrt(d0))
+        ).astype(jnp.float32)
+        params["cross_b%d" % i] = jnp.zeros((d0,), jnp.float32)
+    sizes = [d0] + list(hidden)
+    for i in range(len(hidden)):
+        params["deep_w%d" % i] = (
+            jax.random.normal(keys[num_cross_layers + i],
+                              (sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i])
+        ).astype(jnp.float32)
+        params["deep_b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    params["out_w"] = (
+        jax.random.normal(keys[-1], (d0 + sizes[-1], 1)) * 0.01
+    ).astype(jnp.float32)
+    params["out_b"] = jnp.zeros((1,), jnp.float32)
+    return params
+
+
+def forward(params, feats, train):
+    v = feats["emb__" + EMB_TABLE][feats["idx__" + EMB_TABLE]]  # [B,F,k]
+    x0 = v.reshape(v.shape[0], -1)
+    if feats.get("dense") is not None:
+        x0 = jnp.concatenate([x0, feats["dense"]], axis=-1)
+    # cross tower: x_{l+1} = x0 * <w_l, x_l> + b_l + x_l
+    x = x0
+    n_cross = sum(1 for k in params if k.startswith("cross_w"))
+    for i in range(n_cross):
+        xw = x @ params["cross_w%d" % i]                      # [B]
+        x = x0 * xw[:, None] + params["cross_b%d" % i] + x
+    # deep tower
+    h = x0
+    n_deep = sum(1 for k in params if k.startswith("deep_w"))
+    for i in range(n_deep):
+        h = jax.nn.relu(h @ params["deep_w%d" % i]
+                        + params["deep_b%d" % i])
+    out = jnp.concatenate([x, h], axis=-1) @ params["out_w"]
+    return out[:, 0] + params["out_b"][0]
+
+
+def model_spec(num_dense=4, num_fields=8, vocab_size=10000,
+               embedding_dim=8, num_cross_layers=3, hidden=(128, 64),
+               learning_rate=1e-3):
+    def init_fn(rng):
+        return init_params(rng, num_dense, num_fields, embedding_dim,
+                           num_cross_layers, hidden)
+
+    def loss_fn(logits, labels):
+        return optax.sigmoid_binary_cross_entropy(
+            logits, labels.astype(jnp.float32)
+        )
+
+    def feed(records):
+        dense = np.stack([np.asarray(r[0], np.float32) for r in records])
+        ids = np.stack([np.asarray(r[1], np.int64) for r in records])
+        labels = np.asarray([int(r[2]) for r in records], np.int32)
+        return {"dense": dense, "__ids__": {EMB_TABLE: ids}}, labels
+
+    return ModelSpec(
+        name="dcn",
+        init_fn=init_fn,
+        apply_fn=lambda p, f, t: forward(p, f, t),
+        loss_fn=loss_fn,
+        optimizer=optax.adam(learning_rate),
+        feed=feed,
+        eval_metrics_fn=lambda: {
+            "auc": metrics.AUC(),
+            "accuracy": metrics.BinaryAccuracy(threshold=0.0),
+        },
+        ps_embedding_infos=[
+            {"name": EMB_TABLE, "dim": embedding_dim,
+             "initializer": "uniform"},
+        ],
+        ps_optimizer=("adam", "learning_rate=%g" % learning_rate),
+    )
+
+
+synthetic_data = _ctr.synthetic_data

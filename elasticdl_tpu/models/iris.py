@@ -1,0 +1,60 @@
+"""Iris DNN classifier from CSV — model_zoo iris/heart-style simple
+tabular model (reference model_zoo/iris, odps_iris)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.utils import metrics
+
+
+class IrisDNN(nn.Module):
+    num_classes: int = 3
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def feed(records):
+    """records: CSV rows [f0, f1, f2, f3, label]."""
+    xs = np.asarray(
+        [[float(v) for v in r[:4]] for r in records], np.float32
+    )
+    ys = np.asarray([int(float(r[4])) for r in records], np.int32)
+    return xs, ys
+
+
+def model_spec(learning_rate=0.01, num_classes=3):
+    model = IrisDNN(num_classes=num_classes)
+
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1, 4)))["params"]
+
+    return ModelSpec(
+        name="iris",
+        init_fn=init_fn,
+        apply_fn=lambda p, x, t: model.apply({"params": p}, x, train=t),
+        loss_fn=lambda logits, labels:
+            optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                            labels),
+        optimizer=optax.adam(learning_rate),
+        feed=feed,
+        eval_metrics_fn=lambda: {"accuracy": metrics.Accuracy()},
+    )
+
+
+def synthetic_iris_csv(path, n=150, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.array([[5.0, 3.4, 1.5, 0.2], [5.9, 2.8, 4.3, 1.3],
+                        [6.6, 3.0, 5.6, 2.1]])
+    with open(path, "w") as f:
+        for _ in range(n):
+            y = rng.randint(3)
+            x = centers[y] + rng.randn(4) * 0.25
+            f.write(",".join("%.2f" % v for v in x) + ",%d\n" % y)
+    return path

@@ -1,0 +1,114 @@
+"""MobileNetV2 — the reference's second CIFAR-10 benchmark model
+(docs/benchmark/ftlib_benchmark.md:45-51, 83-86: 2,236,682 params).
+
+Same TPU-first conventions as resnet.py: NHWC, GroupNorm, bf16 compute via
+the trainer.  Depthwise convs use feature_group_count (XLA lowers these to
+efficient TPU convolutions).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.utils import metrics
+
+
+def _gn(channels):
+    return nn.GroupNorm(num_groups=int(np.gcd(8, channels)))
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    stride: int
+    expand_ratio: int
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand_ratio
+        y = x
+        if self.expand_ratio != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False)(y)
+            y = _gn(hidden)(y)
+            y = nn.relu6(y)
+        y = nn.Conv(
+            hidden, (3, 3), strides=(self.stride, self.stride),
+            padding="SAME", feature_group_count=hidden, use_bias=False,
+        )(y)
+        y = _gn(hidden)(y)
+        y = nn.relu6(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = _gn(self.filters)(y)
+        if self.stride == 1 and in_ch == self.filters:
+            y = y + x
+        return y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 10
+    width_mult: float = 1.0
+    cifar_stem: bool = True
+
+    # (expand_ratio, channels, repeats, stride)
+    config: tuple = (
+        (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        def c(ch):
+            return max(8, int(ch * self.width_mult))
+
+        stem_stride = 1 if self.cifar_stem else 2
+        x = nn.Conv(c(32), (3, 3), strides=(stem_stride, stem_stride),
+                    padding="SAME", use_bias=False)(x)
+        x = _gn(c(32))(x)
+        x = nn.relu6(x)
+        for expand, ch, repeats, stride in self.config:
+            for i in range(repeats):
+                x = InvertedResidual(
+                    filters=c(ch),
+                    stride=stride if i == 0 else 1,
+                    expand_ratio=expand,
+                )(x)
+        x = nn.Conv(c(1280), (1, 1), use_bias=False)(x)
+        x = _gn(c(1280))(x)
+        x = nn.relu6(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def model_spec(num_classes=10, image_size=32, learning_rate=0.05,
+               cifar_stem=True):
+    model = MobileNetV2(num_classes=num_classes, cifar_stem=cifar_stem)
+
+    def init_fn(rng):
+        return model.init(
+            rng, jnp.zeros((1, image_size, image_size, 3))
+        )["params"]
+
+    def apply_fn(params, x, train):
+        return model.apply({"params": params}, x, train=train)
+
+    def loss_fn(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        )
+
+    def feed(records):
+        xs = np.stack([np.asarray(r[0], np.float32) for r in records])
+        ys = np.asarray([int(r[1]) for r in records], np.int32)
+        return xs, ys
+
+    return ModelSpec(
+        name="mobilenetv2",
+        init_fn=init_fn,
+        apply_fn=apply_fn,
+        loss_fn=loss_fn,
+        optimizer=optax.sgd(learning_rate, momentum=0.9),
+        feed=feed,
+        eval_metrics_fn=lambda: {"accuracy": metrics.Accuracy()},
+    )

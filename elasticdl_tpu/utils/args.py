@@ -17,6 +17,10 @@ def _str2bool(v):
 
 def add_common_args(parser):
     parser.add_argument("--job_name", default="elasticdl-tpu-job")
+    parser.add_argument("--job_type", default="train",
+                        choices=["train", "evaluate", "predict"])
+    parser.add_argument("--prediction_outputs", default="predictions",
+                        help="output dir for predict jobs")
     parser.add_argument("--model_zoo", default="mnist",
                         help="zoo module name or dotted path")
     parser.add_argument("--data_origin", default="synthetic_mnist",

@@ -46,12 +46,24 @@ def build_worker(args):
         args.data_origin, records_per_shard=records_per_task
     )
     saver = None
-    if args.checkpoint_dir and worker_id == 0:
-        # Only one writer: checkpoints are saved by worker 0 (the
-        # collective path replicates params, so any single worker's copy
-        # is the model).
+    checkpoint_steps = args.checkpoint_steps
+    if args.checkpoint_dir:
         saver = CheckpointSaver(
             args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+        )
+        if worker_id != 0:
+            # Every worker may restore, but only worker 0 writes (the
+            # collective path replicates params, so any single copy is
+            # the model).
+            checkpoint_steps = 0
+    if args.job_type == "predict" and spec.prediction_outputs_processor \
+            is None:
+        from elasticdl_tpu.worker.prediction_outputs_processor import (
+            NpzPredictionWriter,
+        )
+
+        spec.prediction_outputs_processor = NpzPredictionWriter(
+            args.prediction_outputs
         )
     if args.distribution_strategy == "ps":
         from elasticdl_tpu.worker.ps_client import build_ps_client
@@ -89,7 +101,7 @@ def build_worker(args):
         report_version_steps=max(1, args.evaluation_steps // 4)
         if args.evaluation_steps else 0,
         checkpoint_saver=saver,
-        checkpoint_steps=args.checkpoint_steps,
+        checkpoint_steps=checkpoint_steps,
         use_bf16_compute=args.use_bf16,
         rng_seed=args.seed,
     )

@@ -1,0 +1,43 @@
+"""Prediction output handling (parity:
+elasticdl/python/worker/prediction_outputs_processor.py:17-35)."""
+
+import abc
+import os
+import threading
+
+import numpy as np
+
+
+class BasePredictionOutputsProcessor(abc.ABC):
+    @abc.abstractmethod
+    def process(self, predictions, worker_id):
+        """Called per prediction minibatch with the model outputs."""
+
+
+class NpzPredictionWriter(BasePredictionOutputsProcessor):
+    """Accumulates prediction batches and writes one .npz per worker."""
+
+    def __init__(self, output_dir):
+        self.output_dir = output_dir
+        self._chunks = []
+        self._lock = threading.Lock()
+        os.makedirs(output_dir, exist_ok=True)
+
+    def process(self, predictions, worker_id):
+        with self._lock:
+            self._chunks.append(np.asarray(predictions))
+            self._worker_id = worker_id
+
+    def flush(self):
+        with self._lock:
+            if not self._chunks:
+                return None
+            out = np.concatenate(self._chunks)
+            path = os.path.join(
+                self.output_dir,
+                "predictions-worker-%d.npz" % self._worker_id,
+            )
+            with open(path, "wb") as f:
+                np.savez(f, predictions=out)
+            self._chunks = []
+        return path

@@ -46,6 +46,9 @@ class Worker:
 
     def _process_minibatch(self, features, labels):
         err = None
+        for callback in self._spec.callbacks:
+            if hasattr(callback, "on_train_batch_begin"):
+                callback.on_train_batch_begin(self._trainer)
         for attempt in range(self._max_minibatch_retries):
             try:
                 loss, version = self._trainer.train_minibatch(
@@ -104,6 +107,8 @@ class Worker:
                 outputs = self._trainer.predict_minibatch(features)
                 if processor is not None:
                     processor.process(outputs, self._mc.worker_id)
+            if processor is not None and hasattr(processor, "flush"):
+                processor.flush()
             self._shard_service.report_task_done(task)
         except Exception as e:  # noqa: BLE001
             self._shard_service.report_task_failed(task, str(e))

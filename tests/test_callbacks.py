@@ -1,0 +1,66 @@
+import json
+import os
+
+import numpy as np
+
+from elasticdl_tpu.models import mnist
+from elasticdl_tpu.models.callbacks import (
+    LearningRateScheduler,
+    ModelExporter,
+    load_export,
+)
+from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+
+def test_model_exporter_roundtrip(tmp_path):
+    spec = mnist.model_spec()
+    trainer = CollectiveTrainer(spec, batch_size=8)
+    xs, ys = mnist.synthetic_data(n=8)
+    trainer.train_minibatch(xs, ys)
+    export_dir = str(tmp_path / "export")
+    ModelExporter(export_dir, model_name="mnist").on_train_end(trainer)
+    assert os.path.exists(os.path.join(export_dir, "model.npz"))
+    with open(os.path.join(export_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["model_name"] == "mnist"
+    dense, embeddings = load_export(export_dir)
+    live = trainer.export_parameters()
+    assert set(dense) == set(live)
+    for k in live:
+        np.testing.assert_array_equal(dense[k], live[k])
+
+
+def test_model_exporter_merges_ps_checkpoint(tmp_path):
+    ckpt = CheckpointSaver(str(tmp_path / "ckpt"))
+    ckpt.save(
+        5,
+        dense={"ps_only/w": np.ones(3, np.float32)},
+        embeddings={"table": (np.array([1, 2]),
+                              np.ones((2, 4), np.float32))},
+    )
+    spec = mnist.model_spec()
+    trainer = CollectiveTrainer(spec, batch_size=8)
+    export_dir = str(tmp_path / "export")
+    ModelExporter(
+        export_dir, checkpoint_dir=str(tmp_path / "ckpt")
+    ).on_train_end(trainer)
+    dense, embeddings = load_export(export_dir)
+    assert "ps_only/w" in dense
+    assert "table" in embeddings
+    ids, values = embeddings["table"]
+    assert sorted(ids.tolist()) == [1, 2]
+
+
+def test_lr_scheduler_sets_ps_trainer_lr():
+    class FakeTrainer:
+        version = 100
+        _learning_rate = 0.0
+
+    scheduler = LearningRateScheduler(
+        lambda version: 0.1 if version < 50 else 0.01
+    )
+    trainer = FakeTrainer()
+    lr = scheduler.on_train_batch_begin(trainer)
+    assert lr == 0.01
+    assert trainer._learning_rate == 0.01

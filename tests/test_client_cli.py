@@ -1,0 +1,54 @@
+"""Client CLI: zoo tooling, k8s rendering, job arg plumbing."""
+
+import os
+
+from elasticdl_tpu.client import k8s_renderer
+from elasticdl_tpu.client.main import _split_args, _zoo_init, main
+
+
+def test_zoo_init_scaffolds_project(tmp_path):
+    path = str(tmp_path / "zoo")
+
+    class A:
+        pass
+
+    args = A()
+    args.path = path
+    assert _zoo_init(args) == 0
+    assert os.path.exists(os.path.join(path, "my_model.py"))
+    assert os.path.exists(os.path.join(path, "Dockerfile"))
+    # scaffolded model module must satisfy the zoo contract
+    import importlib.util
+
+    spec_mod = importlib.util.spec_from_file_location(
+        "my_model", os.path.join(path, "my_model.py")
+    )
+    module = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(module)
+    spec = module.model_spec()
+    assert spec.name == "my_model"
+
+
+def test_split_args_passthrough():
+    cli, rest = _split_args([
+        "--platform", "k8s", "--image", "img:1",
+        "--model_zoo", "mnist", "--batch_size", "64",
+    ])
+    assert cli.platform == "k8s" and cli.image == "img:1"
+    assert rest == ["--model_zoo", "mnist", "--batch_size", "64"]
+
+
+def test_k8s_manifest_renders_master_pod():
+    manifest = k8s_renderer.render_master_manifest(
+        ["--job_name", "myjob", "--model_zoo", "mnist"],
+        image="img:2", namespace="ml",
+    )
+    assert "name: myjob-master" in manifest
+    assert "namespace: ml" in manifest
+    assert "image: img:2" in manifest
+    assert "elasticdl-tpu-job-name: myjob" in manifest
+    assert '"--model_zoo"' in manifest
+
+
+def test_cli_help_and_unknown():
+    assert main([]) == 1

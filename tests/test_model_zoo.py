@@ -1,0 +1,104 @@
+"""Model-zoo smoke + convergence tests across the families."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.models import (
+    dcn,
+    iris,
+    mobilenet,
+    wide_deep,
+    xdeepfm,
+)
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+from tests.test_pserver import start_ps, stop_all
+
+
+def test_load_model_spec_by_short_name():
+    spec = load_model_spec("mnist")
+    assert spec.name == "mnist"
+    spec = load_model_spec("elasticdl_tpu.models.iris")
+    assert spec.name == "iris"
+
+
+def test_mobilenetv2_param_count_near_reference():
+    """Reference MobileNetV2 has 2,236,682 params
+    (ftlib_benchmark.md:45); ours should land in the same ballpark
+    (GroupNorm vs BatchNorm shifts the count slightly)."""
+    import jax
+
+    spec = mobilenet.model_spec()
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    count = sum(np.prod(p.shape) for p in
+                jax.tree_util.tree_leaves(params))
+    assert 1.8e6 < count < 2.8e6, count
+
+
+def test_mobilenetv2_trains():
+    spec = mobilenet.model_spec(learning_rate=0.01)
+    trainer = CollectiveTrainer(spec, batch_size=8)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 32, 32, 3).astype(np.float32)
+    ys = rng.randint(0, 10, 8).astype(np.int32)
+    loss, _ = trainer.train_minibatch(xs, ys)
+    assert np.isfinite(loss)
+
+
+def test_iris_learns_from_csv(tmp_path):
+    path = iris.synthetic_iris_csv(str(tmp_path / "iris.csv"), n=120)
+    with open(path) as f:
+        records = [line.strip().split(",") for line in f]
+    spec = iris.model_spec(learning_rate=0.05)
+    trainer = CollectiveTrainer(spec, batch_size=32)
+    for _ in range(12):
+        for i in range(0, 120, 32):
+            xs, ys = spec.feed(records[i:i + 32])
+            trainer.train_minibatch(xs, ys)
+    xs, ys = spec.feed(records)
+    correct = 0
+    for i in range(0, 120, 32):
+        out, labels = trainer.evaluate_minibatch(xs[i:i + 32],
+                                                 ys[i:i + 32])
+        correct += (np.argmax(out, -1) == labels).sum()
+    assert correct / 120 > 0.8
+
+
+@pytest.mark.parametrize("module", [dcn, xdeepfm])
+def test_ctr_models_train_through_ps(module):
+    spec = module.model_spec(vocab_size=500, embedding_dim=4,
+                             hidden=(16,))
+    client, servicers, servers = start_ps(
+        num_ps=1, opt_type="adam", opt_args="learning_rate=0.01",
+    )
+    try:
+        trainer = ParameterServerTrainer(spec, client, batch_size=32)
+        dense, ids, labels = module.synthetic_data(n=64, vocab_size=500)
+        records = [(dense[i], ids[i], labels[i]) for i in range(64)]
+        feats, ys = spec.feed(records[:32])
+        loss1, _ = trainer.train_minibatch(feats, ys)
+        for _ in range(10):
+            loss2, _ = trainer.train_minibatch(feats, ys)
+        assert np.isfinite(loss2) and loss2 < loss1
+    finally:
+        stop_all(servers)
+
+
+def test_wide_deep_census_through_ps():
+    spec = wide_deep.model_spec(embedding_dim=4, hidden=(16,))
+    client, servicers, servers = start_ps(
+        num_ps=2, opt_type="adam", opt_args="learning_rate=0.01",
+    )
+    try:
+        trainer = ParameterServerTrainer(spec, client, batch_size=32)
+        rows = wide_deep.synthetic_census_rows(n=256)
+        losses = []
+        for epoch in range(4):
+            for i in range(0, 256, 32):
+                feats, ys = spec.feed(rows[i:i + 32])
+                loss, _ = trainer.train_minibatch(feats, ys)
+                losses.append(loss)
+        assert losses[-1] < losses[0]
+    finally:
+        stop_all(servers)

@@ -1,0 +1,130 @@
+import numpy as np
+import pytest
+
+from elasticdl_tpu.preprocessing import analyzer_utils
+from elasticdl_tpu.preprocessing.layers import (
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    Normalizer,
+    RaggedBatch,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+    ToRagged,
+    ToSparse,
+)
+
+
+def test_log_round_reference_example():
+    # reference docstring: base=2, [[1.2],[1.6],[0.2],[3.1],[100]]
+    #   -> [[0],[1],[0],[2],[7]] (log_round.py:29-40)
+    layer = LogRound(num_bins=16, base=2)
+    out = layer(np.array([[1.2], [1.6], [0.2], [3.1], [100]]))
+    np.testing.assert_array_equal(out, [[0], [1], [0], [2], [7]])
+
+
+def test_round_identity_reference_example():
+    layer = RoundIdentity(num_buckets=5)
+    out = layer(np.array([[1.2], [1.6], [0.2], [3.1], [4.9]]))
+    np.testing.assert_array_equal(out, [[1], [2], [0], [3], [4]])
+
+
+def test_concatenate_with_offset_reference_example():
+    a1 = np.array([[1], [1], [1]])
+    a2 = np.array([[2], [2], [2]])
+    layer = ConcatenateWithOffset(offsets=[0, 10], axis=1)
+    np.testing.assert_array_equal(
+        layer([a1, a2]), [[1, 12], [1, 12], [1, 12]]
+    )
+
+
+def test_discretization():
+    layer = Discretization([0.0, 1.0, 10.0])
+    np.testing.assert_array_equal(
+        layer(np.array([-5.0, 0.5, 5.0, 50.0])), [0, 1, 2, 3]
+    )
+
+
+def test_hashing_deterministic_and_bounded():
+    layer = Hashing(num_bins=7)
+    ints = layer(np.arange(100))
+    assert ((np.asarray(ints) >= 0) & (np.asarray(ints) < 7)).all()
+    np.testing.assert_array_equal(layer(np.arange(100)), ints)
+    strs = layer(np.array(["cat", "dog", "cat"], dtype=object))
+    assert strs[0] == strs[2]
+    assert 0 <= strs[1] < 7
+
+
+def test_index_lookup_with_oov():
+    layer = IndexLookup(["a", "b", "c"])
+    np.testing.assert_array_equal(
+        layer(np.array(["b", "zzz", "a"], dtype=object)), [1, 3, 0]
+    )
+    assert layer.vocab_size() == 4
+
+
+def test_normalizer():
+    layer = Normalizer(subtract=2.0, divide=4.0)
+    np.testing.assert_allclose(layer(np.array([2.0, 6.0])), [0.0, 1.0])
+
+
+def test_to_number_with_defaults():
+    layer = ToNumber(out_type=np.float32, default_value=-1)
+    out = layer(np.array(["1.5", "", b"2.5", "bad"], dtype=object))
+    np.testing.assert_allclose(out, [1.5, -1.0, 2.5, -1.0])
+
+
+def test_to_ragged_and_dense_mask():
+    rb = ToRagged(sep=",")(["1,2,3", "4", ""])
+    assert isinstance(rb, RaggedBatch)
+    assert rb.row_lengths.tolist() == [3, 1, 0]
+    ids = rb.map_values(lambda v: ToNumber(np.int64)(v))
+    dense, mask = ids.to_dense(max_len=3)
+    np.testing.assert_array_equal(dense, [[1, 2, 3], [4, 0, 0],
+                                          [0, 0, 0]])
+    np.testing.assert_array_equal(
+        mask, [[1, 1, 1], [1, 0, 0], [0, 0, 0]]
+    )
+
+
+def test_to_sparse_shares_representation():
+    rb = ToSparse()(["a,b", "c"])
+    assert isinstance(rb, RaggedBatch)
+
+
+def test_ragged_concatenate_with_offset():
+    r1 = RaggedBatch.from_rows([[1, 2], [3]])
+    r2 = RaggedBatch.from_rows([[5], [6, 7]])
+    out = ConcatenateWithOffset(offsets=[0, 10])([r1, r2])
+    assert [r.tolist() for r in out.rows()] == [[1, 2, 15], [3, 16, 17]]
+
+
+@pytest.mark.parametrize("combiner,expect", [
+    ("sum", [3.0, 0.0]),
+    ("mean", [1.5, 0.0]),
+    ("sqrtn", [3.0 / np.sqrt(2), 0.0]),
+])
+def test_sparse_embedding_combiners(combiner, expect):
+    rows = np.array(
+        [[[1.0], [2.0], [9.0]], [[9.0], [9.0], [9.0]]], np.float32
+    )
+    mask = np.array([[1, 1, 0], [0, 0, 0]], np.float32)
+    out = SparseEmbedding(combiner)(rows, mask)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expect, rtol=1e-6)
+
+
+def test_analyzer_utils_roundtrip(monkeypatch):
+    analyzer_utils.set_stats(
+        "age", min=0, max=100, avg=35.5, stddev=10.0,
+        count_distinct=77, bucket_boundaries=[10, 20, 30],
+    )
+    assert analyzer_utils.get_min("age") == 0
+    assert analyzer_utils.get_max("age") == 100
+    assert analyzer_utils.get_mean("age") == 35.5
+    assert analyzer_utils.get_stddev("age") == 10.0
+    assert analyzer_utils.get_distinct_count("age") == 77
+    assert analyzer_utils.get_bucket_boundaries("age") == [10, 20, 30]
+    assert analyzer_utils.get_min("unknown", default=5) == 5
