@@ -1,0 +1,85 @@
+"""Elastic PyTorch training loop — parity with the reference's
+model_zoo/mnist/mnist_pytorch.py:32-120 pattern: a stock torch loop made
+elastic by (a) an ElasticDataset that pulls master-assigned record
+indices and (b) the controller's elastic_run wrapper reporting batch
+completion.  Torch runs on CPU here; the framework's control plane is
+framework-agnostic — this is the "wrap your own loop" API surface.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.api.controller import ElasticCollectiveController
+from elasticdl_tpu.api.dataset import ElasticDataset
+from elasticdl_tpu.models import mnist as mnist_zoo
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def build_torch_model():
+    import torch.nn as nn
+
+    return nn.Sequential(
+        nn.Flatten(),
+        nn.Linear(28 * 28, 128),
+        nn.ReLU(),
+        nn.Linear(128, 10),
+    )
+
+
+def train(master_client, n_records=512, batch_size=32, lr=1e-2):
+    """Returns (final_loss, batches_run)."""
+    import torch
+    import torch.nn.functional as F
+
+    xs, ys = mnist_zoo.synthetic_data(n=n_records)
+    source = [(xs[i], ys[i]) for i in range(len(ys))]
+    dataset = ElasticDataset(source, master_client,
+                             batch_size=batch_size)
+    model = build_torch_model()
+    optimizer = torch.optim.Adam(model.parameters(), lr=lr)
+    controller = ElasticCollectiveController(
+        master_client, trainer=model,
+        data_shard_service=dataset.shard_service,
+        global_batch_num=1, check_secs=1e9,
+    )
+
+    def train_one_batch(batch_x, batch_y):
+        optimizer.zero_grad()
+        logits = model(batch_x)
+        loss = F.cross_entropy(logits, batch_y)
+        loss.backward()
+        optimizer.step()
+        return float(loss)
+
+    elastic_train = controller.elastic_run(train_one_batch)
+
+    losses = []
+    batch = []
+    try:
+        with controller.scope():
+            while True:
+                try:
+                    batch.append(dataset[0])
+                except IndexError:
+                    break
+                if len(batch) == batch_size:
+                    bx = torch.tensor(
+                        np.stack([b[0] for b in batch])
+                    )
+                    by = torch.tensor(
+                        np.asarray([b[1] for b in batch],
+                                   dtype=np.int64)
+                    )
+                    losses.append(elastic_train(bx, by))
+                    batch = []
+            if batch:
+                bx = torch.tensor(np.stack([b[0] for b in batch]))
+                by = torch.tensor(
+                    np.asarray([b[1] for b in batch], dtype=np.int64)
+                )
+                losses.append(elastic_train(bx, by))
+    finally:
+        dataset.stop()
+    logger.info("torch elastic loop done: %d batches", len(losses))
+    return (losses[-1] if losses else float("nan")), len(losses)

@@ -1,0 +1,147 @@
+"""Pallas flash attention (forward) for TPU.
+
+The hot op of the long-context path.  One (batch*head, q-block) program
+holds its query tile in VMEM and streams K/V tiles of the same head
+through the MXU with the online-softmax accumulation, so the T x T score
+matrix never materializes in HBM.  Backward currently recomputes with the
+jnp reference implementation via custom_vjp (a dedicated bwd kernel is a
+later optimization); forward-only paths (serving, evaluation) get the full
+benefit.
+
+Layout: [batch, heads, seq, head_dim].  Sequence and head_dim should be
+multiples of the block sizes (128 lanes); `flash_attention` falls back to
+the reference implementation for unfriendly shapes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _attention_ref(q, k, v, causal, scale):
+    """jnp reference in the same [B, H, T, D] layout."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D]
+    block_q = q_ref.shape[1]
+    seq_len = k_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    num_k = seq_len // block_k
+
+    def body(ki, carry):
+        acc, l, m = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bq, bk]
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha[:, None] + pv
+        return acc, l, m_new
+
+    acc = jnp.zeros((block_q, head_dim), jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    acc, l, m = jax.lax.fori_loop(0, num_k, body, (acc, l, m))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    bh = b * h
+    qr = q.reshape(bh, t, d)
+    kr = k.reshape(bh, t, d)
+    vr = v.reshape(bh, t, d)
+    grid = (bh, t // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _attention_ref(q, k, v, causal, scale), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """q, k, v: [batch, heads, seq, head_dim]."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    t = q.shape[2]
+    d = q.shape[3]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k or d % 128 and d not in (64, 128, 256):
+        return _attention_ref(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

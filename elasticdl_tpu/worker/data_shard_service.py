@@ -6,6 +6,7 @@ task done once its shard is fully consumed, so user training loops only call
 ``fetch_shard``/``report_batch_done``.
 """
 
+import queue
 import threading
 import time
 from collections import deque
@@ -30,14 +31,18 @@ class DataShardService:
         self._lock = threading.Lock()
         self._pending = deque()   # tasks whose records are being consumed
         self._record_count = 0
+        self._stopped = threading.Event()
         self.exec_counters = {"batch_count": 0, "record_count": 0}
+
+    def stop(self):
+        self._stopped.set()
 
     def fetch_task(self, task_type=None, wait=True):
         """Fetch the next task; blocks through WAIT tasks if wait=True.
 
         Returns None when the master says the job is finished.
         """
-        while True:
+        while not self._stopped.is_set():
             task_pb = self._mc.get_task(task_type)
             if task_pb.id < 0:
                 if task_pb.type == pb.WAIT and wait:
@@ -85,3 +90,60 @@ class DataShardService:
             except ValueError:
                 pass
         self._mc.report_task_result(task.id, exec_counters=self.exec_counters)
+
+
+class RecordIndexService(DataShardService):
+    """Index-level sharding for map-style datasets
+    (reference elasticai_api data_shard_service.py:161-212): a background
+    thread drains tasks from the master into a queue of record indices, so
+    a torch-style ``Dataset.__getitem__`` can consume dynamic shards
+    without knowing about tasks.
+    """
+
+    def __init__(self, master_client, batch_size=1, queue_size=4096):
+        super().__init__(master_client, batch_size=batch_size)
+        self._index_queue = queue.Queue(maxsize=queue_size)
+        self._fetcher = threading.Thread(
+            target=self._fill_indices, name="record-index-fetcher",
+            daemon=True,
+        )
+        self._exhausted = threading.Event()
+        self._fetcher.start()
+
+    def _fill_indices(self):
+        try:
+            while not self._stopped.is_set():
+                task = self.fetch_task(task_type=pb.TRAINING, wait=True)
+                if task is None:
+                    break
+                if task.shard.record_indices:
+                    indices = list(task.shard.record_indices)
+                else:
+                    indices = list(
+                        range(task.shard.start, task.shard.end)
+                    )
+                for index in indices:
+                    while not self._stopped.is_set():
+                        try:
+                            self._index_queue.put(index, timeout=1.0)
+                            break
+                        except queue.Full:
+                            continue
+        except Exception:  # noqa: BLE001 — master gone: end of stream
+            pass
+        finally:
+            self._exhausted.set()
+            try:
+                self._index_queue.put_nowait(None)
+            except queue.Full:
+                pass
+
+    def fetch_record_index(self, timeout=60.0):
+        """Next record index, or None when the job has no more data."""
+        if self._exhausted.is_set() and self._index_queue.empty():
+            return None
+        index = self._index_queue.get(timeout=timeout)
+        if index is None:
+            self._index_queue.put(None)  # keep signaling other consumers
+            return None
+        return index

@@ -1,0 +1,69 @@
+"""RecordIndexService / ElasticDataset with a live master, plus a real
+torch DataLoader driving the elastic index stream."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.dataset import ElasticDataset
+from tests.test_utils import create_master, create_master_client
+
+
+def test_record_index_service_covers_all_records():
+    master = create_master(
+        training_shards=[("f", 0, 40)], records_per_task=16
+    )
+    try:
+        mc = create_master_client(master)
+        source = list(range(1000, 1040))
+        dataset = ElasticDataset(source, mc, batch_size=8)
+        seen = []
+        while True:
+            try:
+                seen.append(dataset[0])
+            except IndexError:
+                break
+            dataset.report_batch_done(1)
+        assert sorted(v - 1000 for v in seen) == list(range(40))
+        assert master.task_manager.finished()
+    finally:
+        dataset.stop()
+        master.stop()
+
+
+def test_elastic_dataset_with_torch_dataloader():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, Dataset
+
+    master = create_master(
+        training_shards=[("f", 0, 64)], records_per_task=16
+    )
+    try:
+        mc = create_master_client(master)
+        xs = np.arange(64, dtype=np.float32)
+
+        class Source:
+            def __getitem__(self, i):
+                return xs[i]
+
+        elastic = ElasticDataset(Source(), mc, batch_size=8)
+
+        class TorchView(Dataset):
+            def __len__(self):
+                return 64  # upper bound for the sampler
+
+            def __getitem__(self, i):
+                value = elastic[i]
+                return torch.tensor(value)
+
+        loader = DataLoader(TorchView(), batch_size=8, num_workers=0)
+        total = []
+        try:
+            for batch in loader:
+                total.extend(batch.tolist())
+                elastic.report_batch_done(len(batch))
+        except IndexError:
+            pass
+        assert sorted(int(v) for v in total) == list(range(64))
+    finally:
+        elastic.stop()
+        master.stop()

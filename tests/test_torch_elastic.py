@@ -1,0 +1,28 @@
+"""The reference's PyTorch path: a stock torch loop made elastic."""
+
+import time
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from elasticdl_tpu.models import mnist_torch  # noqa: E402
+from tests.test_utils import create_master, create_master_client  # noqa: E402
+
+
+def test_torch_elastic_loop_completes_and_learns():
+    master = create_master(
+        training_shards=[("mem", 0, 512)], records_per_task=64,
+        rendezvous=True,
+    )
+    try:
+        mc = create_master_client(master)
+        time.sleep(0.15)  # rendezvous grace
+        loss, batches = mnist_torch.train(mc, n_records=512,
+                                          batch_size=32)
+        assert batches == 16
+        assert np.isfinite(loss)
+        assert master.task_manager.finished()
+    finally:
+        master.stop()
