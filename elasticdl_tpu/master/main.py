@@ -63,6 +63,15 @@ def build_master(args):
             ),
             **common,
         )
+    if args.job_type == "train" and args.checkpoint_dir:
+        # Resume: the checkpoint version counts optimizer steps; skip the
+        # records those steps consumed so epoch 1 continues where the
+        # previous run stopped.
+        from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+        latest = CheckpointSaver(args.checkpoint_dir).latest_version()
+        if latest:
+            task_manager.skip_records(latest * args.batch_size)
     spec = load_model_spec(args.model_zoo)
     evaluation_service = None
     if args.job_type == "evaluate":

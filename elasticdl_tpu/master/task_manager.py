@@ -161,6 +161,32 @@ class TaskManager:
     def _create_training_tasks(self):
         self._create_tasks(self._training_shards, pb.TRAINING)
 
+    def skip_records(self, num_records):
+        """Drop already-trained records after a checkpoint resume
+        (reference: master recovers completed_steps from the checkpoint
+        version, task_manager.py:208-221).  Whole tasks are dropped while
+        their full span fits in num_records; the remainder trims the next
+        task's front."""
+        with self._lock:
+            skipped = 0
+            while self._todo and num_records - skipped > 0:
+                task = self._todo[0]
+                size = task.shard.size
+                if size <= num_records - skipped:
+                    self._todo.popleft()
+                    skipped += size
+                    self.completed_counts[task.type] += 1
+                else:
+                    trim = num_records - skipped
+                    task.shard.start += trim
+                    if task.shard.record_indices:
+                        task.shard.record_indices = (
+                            task.shard.record_indices[trim:]
+                        )
+                    skipped += trim
+            logger.info("resume: skipped %d records", skipped)
+            return skipped
+
     def create_evaluation_tasks(self, model_version):
         """Version-triggered eval job (reference task_manager create_evaluation_tasks)."""
         with self._lock:

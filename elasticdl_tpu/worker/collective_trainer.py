@@ -165,6 +165,7 @@ class CollectiveTrainer(Trainer):
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
+        self._raw_step = step
         if self._mesh is None:
             return jax.jit(step, donate_argnums=(0, 1))
         rep = self._replicated
@@ -182,6 +183,34 @@ class CollectiveTrainer(Trainer):
         return jax.jit(
             step,
             in_shardings=(rep, rep, batch_in, batch_in, weights_in),
+            out_shardings=(rep, rep, rep),
+            donate_argnums=(0, 1),
+        )
+
+    def build_fused_steps(self, num_steps):
+        """Compile num_steps optimizer steps into ONE XLA program over a
+        fixed device-resident batch — the steps-per-loop pattern that
+        amortizes host dispatch latency on TPU.  Returns
+        fn(params, opt_state, features, labels, weights) ->
+        (params, opt_state, last_loss)."""
+        raw = self._raw_step
+
+        def multi(params, opt_state, features, labels, weights):
+            def body(_i, carry):
+                params, opt_state, _ = carry
+                return raw(params, opt_state, features, labels, weights)
+
+            return jax.lax.fori_loop(
+                0, num_steps, body, (params, opt_state, jnp.float32(0))
+            )
+
+        if self._mesh is None:
+            return jax.jit(multi, donate_argnums=(0, 1))
+        rep = self._replicated
+        return jax.jit(
+            multi,
+            in_shardings=(rep, rep, self._batch_sharding,
+                          self._batch_sharding, self._batch_sharding),
             out_shardings=(rep, rep, rep),
             donate_argnums=(0, 1),
         )

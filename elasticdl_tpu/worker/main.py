@@ -120,7 +120,13 @@ def main(argv=None):
     args = parse_worker_args(argv)
     logger.info("worker starting: %s", vars(args))
     worker = build_worker(args)
-    worker.run()
+    if args.profile_dir:
+        from elasticdl_tpu.utils.timing import device_trace
+
+        with device_trace(args.profile_dir):
+            worker.run()
+    else:
+        worker.run()
     logger.info("worker done")
     return 0
 

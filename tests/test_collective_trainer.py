@@ -69,6 +69,27 @@ def test_elastic_mesh_rebuild(spec):
     assert trainer.global_device_count == 4
 
 
+def test_fused_steps_match_sequential(spec):
+    """K fused steps in one XLA program == K sequential step calls."""
+    xs, ys = mnist.synthetic_data(n=16, seed=9)
+    w = np.ones(16, np.float32)
+    seq = CollectiveTrainer(spec, batch_size=16, rng_seed=2)
+    fused_tr = CollectiveTrainer(spec, batch_size=16, rng_seed=2)
+    for _ in range(3):
+        seq.train_minibatch(xs, ys)
+    fused = fused_tr.build_fused_steps(3)
+    p, o, loss = fused(fused_tr._params, fused_tr._opt_state, xs, ys, w)
+    p_seq = seq.export_parameters()
+    import jax
+
+    from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
+
+    p_fused, _ = flatten_with_names(to_numpy(p))
+    for k in p_seq:
+        np.testing.assert_allclose(p_seq[k], p_fused[k], rtol=2e-4,
+                                   atol=1e-6)
+
+
 def test_checkpoint_restore_roundtrip(spec, tmp_path):
     saver = CheckpointSaver(str(tmp_path))
     xs, ys = mnist.synthetic_data(n=16)

@@ -1,0 +1,30 @@
+import numpy as np
+
+from elasticdl_tpu.data.sql_reader import SQLTableDataReader, SQLTableWriter
+from elasticdl_tpu.master.task_manager import TaskManager
+
+
+def test_sql_reader_shards_and_reads(tmp_path):
+    db = str(tmp_path / "data.db")
+    writer = SQLTableWriter(db, "samples", ["f0", "f1", "label"])
+    rows = [[float(i), float(i * 2), i % 2] for i in range(95)]
+    writer.write(rows)
+    writer.close()
+
+    reader = SQLTableDataReader(db, "samples", records_per_shard=30)
+    assert reader.get_size() == 95
+    assert reader.columns == ["f0", "f1", "label"]
+    shards = reader.create_shards()
+    assert [s[2] - s[1] for s in shards] == [30, 30, 30, 5]
+
+    tm = TaskManager(training_shards=shards, records_per_task=30)
+    seen = []
+    while True:
+        task = tm.get(0)
+        if task is None:
+            break
+        for record in reader.read_records(task):
+            seen.append(record[0])
+        tm.report(task.id, True)
+    np.testing.assert_array_equal(sorted(seen), [float(i) for i in
+                                                 range(95)])
