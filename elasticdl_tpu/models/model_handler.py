@@ -1,0 +1,106 @@
+"""Embedding placement planning — the ModelHandler analog.
+
+The reference swaps ``tf.keras.layers.Embedding`` for PS-backed layers
+when a table exceeds 2 MB (model_handler.py:98-102, threshold at
+EMBEDDING_SIZE_THRESHOLD_IN_BYTES) and reverses the transform for export.
+Here the same decision routes each declared embedding table either to the
+parameter server (bigger than the threshold / HBM budget) or to a
+device-resident parameter (small tables train fastest as plain params
+inside the jitted step with the collective path).
+
+``localize_spec`` rewrites a PS-style ModelSpec so chosen tables become
+ordinary parameters: the model's forward already consumes
+``emb__<table>[idx__<table>]``, so a local table is just the full [V, d]
+array passed as ``emb__<table>`` with raw ids as indices — no model code
+changes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# reference: 2 MB threshold (model_handler.py:98-102)
+EMBEDDING_PS_THRESHOLD_BYTES = 2 * 1024 * 1024
+
+
+def plan_embedding_placement(infos, vocab_sizes,
+                             threshold_bytes=EMBEDDING_PS_THRESHOLD_BYTES):
+    """Split table names into {"ps": [...], "device": [...]} by size."""
+    plan = {"ps": [], "device": []}
+    for info in infos:
+        name = info["name"]
+        vocab = vocab_sizes.get(name)
+        if vocab is None:
+            plan["ps"].append(name)  # unknown vocab: assume large
+            continue
+        size = vocab * info["dim"] * 4
+        plan["device" if size < threshold_bytes else "ps"].append(name)
+    return plan
+
+
+def localize_spec(spec, vocab_sizes, tables=None, seed=0):
+    """Return a new ModelSpec with the given tables (default: all below
+    the PS threshold) turned into device-resident parameters."""
+    infos = {i["name"]: i for i in spec.ps_embedding_infos}
+    if tables is None:
+        tables = plan_embedding_placement(
+            spec.ps_embedding_infos, vocab_sizes
+        )["device"]
+    tables = [t for t in tables if t in infos]
+    if not tables:
+        return spec
+    logger.info("localizing embedding tables onto device: %s", tables)
+
+    base_init = spec.init_fn
+    base_apply = spec.apply_fn
+    base_feed = spec.feed
+    local_infos = {t: infos[t] for t in tables}
+
+    def init_fn(rng):
+        params = base_init(rng)
+        for i, (t, info) in enumerate(sorted(local_infos.items())):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+            vocab = vocab_sizes[t]
+            init = info.get("initializer", "uniform")
+            if init == "zeros":
+                table = jnp.zeros((vocab, info["dim"]), jnp.float32)
+            else:
+                table = jax.random.uniform(
+                    key, (vocab, info["dim"]), jnp.float32, -0.05, 0.05
+                )
+            params["local_emb__" + t] = table
+        return params
+
+    def apply_fn(params, feats, train):
+        feats = dict(feats)
+        for t in tables:
+            feats["emb__" + t] = params["local_emb__" + t]
+        return base_apply(params, feats, train)
+
+    def feed(records):
+        features, labels = base_feed(records)
+        ids_map = features.get("__ids__", {})
+        for t in tables:
+            ids = ids_map.pop(t, None)
+            if ids is not None:
+                features["idx__" + t] = np.asarray(ids, np.int32)
+        if not ids_map:
+            features.pop("__ids__", None)
+        return features, labels
+
+    return dataclasses.replace(
+        spec,
+        init_fn=init_fn,
+        apply_fn=apply_fn,
+        feed=feed,
+        ps_embedding_infos=[
+            i for i in spec.ps_embedding_infos
+            if i["name"] not in tables
+        ],
+    )

@@ -42,6 +42,10 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
     tied_embeddings: bool = True
+    # Mixture-of-experts: 0 = dense FFN; >0 = switch-style top-1 routing
+    # with experts sharded over the ``ep`` mesh axis.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self):
@@ -70,19 +74,30 @@ def init_params(rng, cfg):
         return jax.random.normal(key, shape, jnp.float32) * scale
 
     keys = jax.random.split(k_attn, 6)
+    layers = {
+        "ln1": norm_init(L, E),
+        "wq": dense_init(keys[0], L, E, H * D),
+        "wk": dense_init(keys[1], L, E, H * D),
+        "wv": dense_init(keys[2], L, E, H * D),
+        "wo": dense_init(keys[3], L, H * D, E),
+        "ln2": norm_init(L, E),
+    }
+    if cfg.moe_experts:
+        X = cfg.moe_experts
+        layers["w_router"] = dense_init(keys[4], L, E, X, scale=0.02)
+        layers["w_gate"] = dense_init(keys[5], L, X, E, F)
+        layers["w_up"] = dense_init(jax.random.fold_in(k_mlp, 0),
+                                    L, X, E, F)
+        layers["w_down"] = dense_init(jax.random.fold_in(k_mlp, 1),
+                                      L, X, F, E)
+    else:
+        layers["w_gate"] = dense_init(keys[4], L, E, F)
+        layers["w_up"] = dense_init(keys[5], L, E, F)
+        layers["w_down"] = dense_init(jax.random.fold_in(k_mlp, 1),
+                                      L, F, E)
     params = {
         "embed": dense_init(k_embed, cfg.vocab_size, E, scale=0.02),
-        "layers": {
-            "ln1": norm_init(L, E),
-            "wq": dense_init(keys[0], L, E, H * D),
-            "wk": dense_init(keys[1], L, E, H * D),
-            "wv": dense_init(keys[2], L, E, H * D),
-            "wo": dense_init(keys[3], L, H * D, E),
-            "ln2": norm_init(L, E),
-            "w_gate": dense_init(keys[4], L, E, F),
-            "w_up": dense_init(keys[5], L, E, F),
-            "w_down": dense_init(jax.random.fold_in(k_mlp, 1), L, F, E),
-        },
+        "layers": layers,
         "ln_f": norm_init(E),
     }
     if not cfg.tied_embeddings:
@@ -92,19 +107,26 @@ def init_params(rng, cfg):
 
 def param_specs(cfg):
     """PartitionSpec tree matching init_params' structure."""
+    layers = {
+        "ln1": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "ln2": P("pp", None),
+    }
+    if cfg.moe_experts:
+        layers["w_router"] = P("pp", None, None)
+        layers["w_gate"] = P("pp", "ep", None, "tp")
+        layers["w_up"] = P("pp", "ep", None, "tp")
+        layers["w_down"] = P("pp", "ep", "tp", None)
+    else:
+        layers["w_gate"] = P("pp", None, "tp")
+        layers["w_up"] = P("pp", None, "tp")
+        layers["w_down"] = P("pp", "tp", None)
     specs = {
         "embed": P(None, "tp"),
-        "layers": {
-            "ln1": P("pp", None),
-            "wq": P("pp", None, "tp"),
-            "wk": P("pp", None, "tp"),
-            "wv": P("pp", None, "tp"),
-            "wo": P("pp", "tp", None),
-            "ln2": P("pp", None),
-            "w_gate": P("pp", None, "tp"),
-            "w_up": P("pp", None, "tp"),
-            "w_down": P("pp", "tp", None),
-        },
+        "layers": layers,
         "ln_f": P(None),
     }
     if not cfg.tied_embeddings:
@@ -147,6 +169,46 @@ def _rope(x, positions):
     return rotated.astype(x.dtype)
 
 
+def _moe_ffn(h, w, cfg, mesh):
+    """Switch-style top-1 MoE FFN (expert weights sharded over ``ep``).
+
+    Dense dispatch/combine einsum formulation (Mesh-TensorFlow style):
+    per-sequence expert capacity bounds compute; overflow tokens pass
+    through the residual only.  No aux load-balance loss yet — router
+    logits stay near-uniform at init which is adequate for the current
+    scale; the aux term is a planned addition.
+    """
+    B, T, E = h.shape
+    X = cfg.moe_experts
+    capacity = max(1, min(T, int(T * cfg.moe_capacity_factor / X) + 1))
+    logits = h @ w["w_router"].astype(h.dtype)            # [B,T,X]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                   # [B,T]
+    onehot = jax.nn.one_hot(expert, X, dtype=jnp.float32)
+    gate = (probs * onehot).sum(axis=-1)                  # [B,T]
+    # position of each token within its expert's capacity (per sequence)
+    pos = jnp.cumsum(onehot, axis=1) - 1.0                # [B,T,X]
+    keep = onehot * (pos < capacity)
+    disp = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32
+    )                                                     # [B,T,X,C]
+    if mesh is not None:
+        disp = _constrain(disp, mesh, P("dp", "sp", "ep", None))
+    xin = jnp.einsum("btxc,bte->xbce", disp, h.astype(jnp.float32))
+    xin = xin.astype(h.dtype)
+    if mesh is not None:
+        xin = _constrain(xin, mesh, P("ep", "dp", None, None))
+    g = jax.nn.silu(
+        jnp.einsum("xbce,xef->xbcf", xin, w["w_gate"].astype(h.dtype))
+    )
+    u = jnp.einsum("xbce,xef->xbcf", xin, w["w_up"].astype(h.dtype))
+    y = jnp.einsum("xbcf,xfe->xbce", g * u,
+                   w["w_down"].astype(h.dtype))
+    out = jnp.einsum("btxc,xbce->bte", disp,
+                     y.astype(jnp.float32))
+    return (out * gate[..., None]).astype(h.dtype)
+
+
 def _constrain(x, mesh, spec):
     if mesh is not None:
         return jax.lax.with_sharding_constraint(
@@ -179,11 +241,15 @@ def forward(params, tokens, cfg, mesh=None):
             attn @ w["wo"].astype(compute_dtype), mesh, act_spec
         )
         h = _rmsnorm(x, w["ln2"].astype(compute_dtype))
-        gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
-        up = h @ w["w_up"].astype(compute_dtype)
-        x = x + _constrain(
-            (gate * up) @ w["w_down"].astype(compute_dtype), mesh, act_spec
-        )
+        if cfg.moe_experts:
+            x = x + _constrain(_moe_ffn(h, w, cfg, mesh), mesh, act_spec)
+        else:
+            gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
+            up = h @ w["w_up"].astype(compute_dtype)
+            x = x + _constrain(
+                (gate * up) @ w["w_down"].astype(compute_dtype), mesh,
+                act_spec,
+            )
         return x, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])

@@ -1,0 +1,53 @@
+"""Multi-host collective bootstrap.
+
+The reference's AllReduce path rebuilds a Horovod/Gloo ring from the
+master-hosted rendezvous (SURVEY §2.12).  The TPU-native equivalent: the
+master's rendezvous epoch hands every worker (rank, world_size,
+coordinator_addr); workers (re-)run ``jax.distributed.initialize`` against
+the epoch's coordinator and rebuild the global mesh.  This module is the
+glue the elastic controller's ``mesh_builder`` hook plugs in
+(api/controller.py: ElasticCollectiveController(mesh_builder=...)).
+
+Single-process worlds skip distributed init entirely, so the same code
+path runs in tests and single-host jobs.
+"""
+
+import jax
+
+from elasticdl_tpu.parallel.mesh import build_mesh
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def initialize_from_rendezvous(rank, world_size, coordinator_addr):
+    """(Re-)initialize jax.distributed for a new membership epoch."""
+    if world_size <= 1 or not coordinator_addr:
+        return False
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — not initialized yet
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_addr,
+        num_processes=world_size,
+        process_id=rank,
+    )
+    logger.info(
+        "jax.distributed initialized: rank %d / %d via %s",
+        rank, world_size, coordinator_addr,
+    )
+    return True
+
+
+def elastic_mesh_builder(pp=1, ep=1, tp=1, sp=1):
+    """Returns a mesh_builder(rank, world_size, coordinator_addr) for
+    ElasticCollectiveController: re-init the collective runtime for the
+    epoch, then build the global dp x pp x ep x tp x sp mesh over all
+    visible devices (dp absorbs whatever the fixed axes leave)."""
+
+    def build(rank, world_size, coordinator_addr):
+        initialize_from_rendezvous(rank, world_size, coordinator_addr)
+        return build_mesh(pp=pp, ep=ep, tp=tp, sp=sp)
+
+    return build

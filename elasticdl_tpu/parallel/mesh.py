@@ -11,28 +11,28 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "pp", "tp", "sp")
+AXES = ("dp", "pp", "ep", "tp", "sp")
 
 
-def build_mesh(dp=None, pp=1, tp=1, sp=1, devices=None):
-    """Build a Mesh with axes (dp, pp, tp, sp).
+def build_mesh(dp=None, pp=1, tp=1, sp=1, ep=1, devices=None):
+    """Build a Mesh with axes (dp, pp, ep, tp, sp).
 
-    dp=None means "whatever is left" after pp*tp*sp.
+    dp=None means "whatever is left" after pp*ep*tp*sp.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = pp * tp * sp
+    fixed = pp * ep * tp * sp
     if dp is None:
         if n % fixed:
             raise ValueError(
-                "%d devices not divisible by pp*tp*sp=%d" % (n, fixed)
+                "%d devices not divisible by pp*ep*tp*sp=%d" % (n, fixed)
             )
         dp = n // fixed
     if dp * fixed != n:
         raise ValueError(
-            "dp*pp*tp*sp=%d != %d devices" % (dp * fixed, n)
+            "dp*pp*ep*tp*sp=%d != %d devices" % (dp * fixed, n)
         )
-    arr = np.array(devices).reshape(dp, pp, tp, sp)
+    arr = np.array(devices).reshape(dp, pp, ep, tp, sp)
     return Mesh(arr, AXES)
 
 

@@ -1,0 +1,51 @@
+"""Flag-system round-trips (reference args_test / arg_parser_test)."""
+
+from elasticdl_tpu.utils.args import (
+    build_arguments_from_parsed_result,
+    parse_master_args,
+    parse_opt_args,
+    parse_ps_args,
+    parse_worker_args,
+)
+
+
+def test_master_args_roundtrip_to_worker():
+    args = parse_master_args([
+        "--model_zoo", "deepfm", "--batch_size", "64",
+        "--num_epochs", "3", "--shuffle", "true",
+        "--distribution_strategy", "ps", "--num_workers", "2",
+    ])
+    flags = build_arguments_from_parsed_result(
+        args, filter_args=("num_workers", "port", "num_ps", "shuffle",
+                           "shuffle_shards", "max_task_retries",
+                           "task_timeout_secs",
+                           "relaunch_on_worker_failure"),
+    )
+    worker_args = parse_worker_args(flags)
+    assert worker_args.model_zoo == "deepfm"
+    assert worker_args.batch_size == 64
+    assert worker_args.num_epochs == 3
+    assert worker_args.distribution_strategy == "ps"
+
+
+def test_bool_flags_survive_roundtrip():
+    args = parse_master_args(["--use_bf16", "True"])
+    flags = build_arguments_from_parsed_result(args)
+    again = parse_master_args(flags)
+    assert again.use_bf16 is True
+    args = parse_master_args(["--use_bf16", "false"])
+    flags = build_arguments_from_parsed_result(args)
+    assert parse_master_args(flags).use_bf16 is False
+
+
+def test_ps_args_and_opt_args():
+    args = parse_ps_args([
+        "--opt_type", "adam",
+        "--opt_args", "learning_rate=0.01;beta_1=0.95;amsgrad=true",
+        "--grads_to_wait", "4", "--use_async", "false",
+    ])
+    assert args.use_async is False and args.grads_to_wait == 4
+    parsed = parse_opt_args(args.opt_args)
+    assert parsed["learning_rate"] == 0.01
+    assert parsed["beta_1"] == 0.95
+    assert parsed["amsgrad"] == "true"

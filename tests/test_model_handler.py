@@ -1,0 +1,56 @@
+"""Embedding placement plan + spec localization (ModelHandler analog)."""
+
+import numpy as np
+
+from elasticdl_tpu.models import deepfm
+from elasticdl_tpu.models.model_handler import (
+    localize_spec,
+    plan_embedding_placement,
+)
+from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+
+def test_placement_threshold_matches_reference_2mb():
+    infos = [
+        {"name": "small", "dim": 8},    # 1000*8*4 = 32 KB -> device
+        {"name": "big", "dim": 64},     # 10M*64*4 = 2.5 GB -> ps
+        {"name": "unknown", "dim": 8},  # no vocab -> ps
+    ]
+    plan = plan_embedding_placement(
+        infos, {"small": 1000, "big": 10_000_000}
+    )
+    assert plan == {"ps": ["big", "unknown"], "device": ["small"]}
+
+
+def test_localized_deepfm_trains_without_ps():
+    vocab = 500
+    spec = deepfm.model_spec(vocab_size=vocab, embedding_dim=4,
+                             hidden=(16,))
+    local = localize_spec(
+        spec,
+        {deepfm.EMB_TABLE: vocab, deepfm.LIN_TABLE: vocab},
+    )
+    assert local.ps_embedding_infos == []  # everything on device
+    trainer = CollectiveTrainer(local, batch_size=32)
+    dense, ids, labels = deepfm.synthetic_data(n=64, vocab_size=vocab)
+    records = [(dense[i], ids[i], labels[i]) for i in range(64)]
+    feats, ys = local.feed(records[:32])
+    assert "__ids__" not in feats
+    loss1, _ = trainer.train_minibatch(feats, ys)
+    for _ in range(15):
+        loss2, _ = trainer.train_minibatch(feats, ys)
+    assert np.isfinite(loss2) and loss2 < loss1
+
+
+def test_hybrid_localization_keeps_big_tables_on_ps():
+    spec = deepfm.model_spec(vocab_size=500, embedding_dim=4)
+    hybrid = localize_spec(
+        spec, {deepfm.LIN_TABLE: 500}, tables=[deepfm.LIN_TABLE]
+    )
+    names = [i["name"] for i in hybrid.ps_embedding_infos]
+    assert names == [deepfm.EMB_TABLE]
+    dense, ids, labels = deepfm.synthetic_data(n=8, vocab_size=500)
+    feats, _ = hybrid.feed([(dense[i], ids[i], labels[i])
+                            for i in range(8)])
+    assert deepfm.LIN_TABLE not in feats.get("__ids__", {})
+    assert deepfm.EMB_TABLE in feats["__ids__"]
