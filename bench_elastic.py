@@ -1,0 +1,113 @@
+"""Elastic recovery benchmark: time from worker preemption to restored
+training progress (the BASELINE.json "elastic recovery time after
+preempt" metric).
+
+Runs a managed job with the process backend, SIGKILLs a worker mid-run,
+and measures:
+  - relaunch_secs: preemption -> replacement worker process launched
+  - recovery_secs: preemption -> first task completed after the
+    preemption (training is demonstrably making progress again)
+
+Control-plane metric: runs on CPU workers; the recovery path is identical
+for TPU-VM workers (same state flows).  Prints one JSON line.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ELASTICDL_TPU_PLATFORM", "cpu")
+
+
+def run_drill(num_workers=2, records=4096):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from elasticdl_tpu.data.factory import create_data_reader
+    from elasticdl_tpu.master.master import Master
+    from elasticdl_tpu.master.task_manager import TaskManager
+    from elasticdl_tpu.master.worker_manager import (
+        ProcessWorkerBackend,
+        WorkerManager,
+    )
+    from elasticdl_tpu.proto import elastic_pb2 as pb
+
+    reader = create_data_reader("synthetic_mnist:%d" % records,
+                                records_per_shard=128)
+    task_manager = TaskManager(
+        training_shards=reader.create_shards(), records_per_task=128,
+        num_epochs=2,
+    )
+    worker_args = [
+        "--model_zoo", "mnist", "--data_origin",
+        "synthetic_mnist:%d" % records, "--batch_size", "32",
+        "--num_minibatches_per_task", "4", "--num_epochs", "2",
+    ]
+    worker_manager = WorkerManager(
+        ProcessWorkerBackend(worker_args=worker_args),
+        num_workers=num_workers,
+    )
+    master = Master(task_manager, worker_manager=worker_manager)
+
+    events = {}
+    launch_times = []
+    worker_manager.add_start_callback(
+        lambda wid: launch_times.append((wid, time.perf_counter()))
+    )
+
+    master.prepare()
+    runner = threading.Thread(target=master.run, daemon=True)
+    runner.start()
+
+    # wait until training is underway (a few tasks done)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if task_manager.counts()["completed"][pb.TRAINING] >= 2:
+            break
+        time.sleep(0.2)
+
+    victim = worker_manager.live_worker_ids()[0]
+    completed_before = task_manager.counts()["completed"][pb.TRAINING]
+    t_kill = time.perf_counter()
+    worker_manager.preempt_worker(victim, force=True)
+
+    # relaunch time: first launch event after the kill
+    relaunch_secs = None
+    recovery_secs = None
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if relaunch_secs is None:
+            later = [t for wid, t in launch_times if t > t_kill]
+            if later:
+                relaunch_secs = later[0] - t_kill
+        counts = task_manager.counts()
+        if counts["completed"][pb.TRAINING] > completed_before:
+            recovery_secs = time.perf_counter() - t_kill
+            break
+        time.sleep(0.05)
+
+    runner.join(timeout=240)
+    master.stop()
+    counts = task_manager.counts()
+    return {
+        "metric": "elastic_recovery_time",
+        "value": round(recovery_secs, 3) if recovery_secs else None,
+        "unit": "seconds",
+        "detail": {
+            "relaunch_secs": round(relaunch_secs, 3)
+            if relaunch_secs else None,
+            "tasks_failed_permanently": counts["failed"][pb.TRAINING],
+            "tasks_completed": counts["completed"][pb.TRAINING],
+            "note": "preemption -> first task completed afterwards; "
+                    "CPU workers (control-plane metric)",
+        },
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_drill()))
+    sys.exit(0)

@@ -44,8 +44,19 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
         )
     if os.path.isdir(data_origin):
         from elasticdl_tpu.data.reader import RecioDataReader
+        from elasticdl_tpu.data.recio_gen import decode_xy
 
         return RecioDataReader(
-            data_origin, decode_fn=kwargs.get("decode_fn")
+            data_origin, decode_fn=kwargs.get("decode_fn", decode_xy)
+        )
+    if data_origin.endswith(".db") or data_origin.startswith("sql:"):
+        from elasticdl_tpu.data.sql_reader import SQLTableDataReader
+
+        spec = data_origin[4:] if data_origin.startswith("sql:") \
+            else data_origin
+        database, _, table = spec.partition("#")
+        return SQLTableDataReader(
+            database, table or "samples",
+            records_per_shard=records_per_shard,
         )
     raise ValueError("cannot infer a data reader for %r" % data_origin)

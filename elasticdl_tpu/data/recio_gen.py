@@ -1,0 +1,61 @@
+"""Dataset -> recio converters (reference: data/recordio_gen/*).
+
+Records serialize as npz-encoded feature dicts; RecioDataReader decodes
+them with ``decode_record``.
+"""
+
+import io
+import os
+
+import numpy as np
+
+from elasticdl_tpu.data.recio import RecioWriter
+
+
+def encode_record(**arrays):
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def decode_record(payload):
+    with np.load(io.BytesIO(payload)) as z:
+        return {k: z[k] for k in z.files}
+
+
+def decode_xy(payload):
+    """Decoder for (x, y) supervised records."""
+    d = decode_record(payload)
+    return d["x"], d["y"]
+
+
+def convert_arrays(output_dir, arrays, records_per_file=2048,
+                   names=("x", "y")):
+    """Write parallel arrays into sharded recio files (one per shard)."""
+    os.makedirs(output_dir, exist_ok=True)
+    n = len(arrays[0])
+    file_index = 0
+    written = []
+    pos = 0
+    while pos < n:
+        end = min(pos + records_per_file, n)
+        path = os.path.join(
+            output_dir, "data-%05d.recio" % file_index
+        )
+        with RecioWriter(path) as w:
+            for i in range(pos, end):
+                w.write(encode_record(
+                    **{name: a[i] for name, a in zip(names, arrays)}
+                ))
+        written.append(path)
+        file_index += 1
+        pos = end
+    return written
+
+
+def convert_synthetic_mnist(output_dir, n=4096, records_per_file=1024):
+    from elasticdl_tpu.models.mnist import synthetic_data
+
+    xs, ys = synthetic_data(n=n)
+    return convert_arrays(output_dir, (xs, ys),
+                          records_per_file=records_per_file)
