@@ -11,6 +11,21 @@ backend, exactly like the reference's pod manager).
 
 import shlex
 
+
+def parse_resource_string(spec):
+    """'cpu=1,memory=4096Mi,google.com/tpu=8' -> k8s resource dict
+    (reference: elasticdl_client/common/k8s_resource.py)."""
+    out = {}
+    for piece in spec.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        key, sep, value = piece.partition("=")
+        if not sep:
+            raise ValueError("bad resource entry %r" % piece)
+        out[key.strip()] = value.strip()
+    return out
+
 _MASTER_POD_TEMPLATE = """apiVersion: v1
 kind: Pod
 metadata:

@@ -20,6 +20,7 @@ logger = get_logger(__name__)
 class ParameterServer:
     def __init__(self, args, master_client=None):
         self.args = args
+        self._master_client = master_client
         self.parameters = Parameters()
         self.optimizer = create_optimizer(args.opt_type, args.opt_args)
         saver = None
@@ -80,6 +81,29 @@ class ParameterServer:
         self._server.start()
         logger.info("PS %d/%d listening on port %d",
                     self.args.ps_id, self.args.num_ps, self.port)
+        if self._master_client is not None:
+            # Self-terminate when the master goes away (reference: the Go
+            # PS polls the master pod every 30s, k8s_client.go:42-60) so
+            # orphaned PS shards never outlive their job.
+            threading.Thread(
+                target=self._watch_master, name="master-watch",
+                daemon=True,
+            ).start()
+
+    def _watch_master(self, poll_secs=30, max_misses=3):
+        misses = 0
+        while not self._done.is_set() and misses < max_misses:
+            self._done.wait(poll_secs)
+            if self._done.is_set():
+                return
+            try:
+                self._master_client.get_comm_rank()
+                misses = 0
+            except Exception:  # noqa: BLE001
+                misses += 1
+        if misses >= max_misses:
+            logger.info("master unreachable; PS shutting down")
+            self.stop()
 
     def run(self):
         self._done.wait()
