@@ -24,6 +24,25 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
         xs = rng.rand(n, 32, 32, 3).astype(np.float32)
         ys = rng.randint(0, 10, size=n).astype(np.int32)
         return ArrayDataReader((xs, ys), records_per_shard=records_per_shard)
+    if data_origin.startswith("synthetic_lm"):
+        from elasticdl_tpu.data.reader import ArrayDataReader
+        import numpy as np
+
+        # "synthetic_lm[:n[:seq_len[:vocab]]]"
+        parts = data_origin.split(":")
+        n = int(parts[1]) if len(parts) > 1 else 2048
+        seq_len = int(parts[2]) if len(parts) > 2 else 128
+        vocab = int(parts[3]) if len(parts) > 3 else 1024
+        rng = np.random.RandomState(0)
+        # learnable structure: arithmetic token sequences mod vocab
+        starts = rng.randint(0, vocab, size=n)
+        steps = rng.randint(1, 7, size=n)
+        toks = (
+            starts[:, None] + steps[:, None] * np.arange(seq_len)[None]
+        ) % vocab
+        return ArrayDataReader(
+            (toks.astype(np.int32),), records_per_shard=records_per_shard
+        )
     if data_origin.startswith("synthetic_ctr"):
         from elasticdl_tpu.data.reader import ArrayDataReader
         from elasticdl_tpu.models import deepfm

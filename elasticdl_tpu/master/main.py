@@ -72,7 +72,8 @@ def build_master(args):
         latest = CheckpointSaver(args.checkpoint_dir).latest_version()
         if latest:
             task_manager.skip_records(latest * args.batch_size)
-    spec = load_model_spec(args.model_zoo)
+    spec = load_model_spec(args.model_zoo,
+                           model_params=args.model_params)
     evaluation_service = None
     if args.job_type == "evaluate":
         if spec.eval_metrics_fn is None:

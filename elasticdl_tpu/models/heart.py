@@ -1,0 +1,64 @@
+"""Heart-disease tabular classifier — model_zoo heart parity
+(13-feature CSV, binary label)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.utils import metrics
+
+NUM_FEATURES = 13
+
+
+class HeartDNN(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.relu(nn.Dense(32)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x)[:, 0]
+
+
+def feed(records):
+    xs = np.asarray(
+        [[float(v) for v in r[:NUM_FEATURES]] for r in records],
+        np.float32,
+    )
+    ys = np.asarray(
+        [int(float(r[NUM_FEATURES])) for r in records], np.int32
+    )
+    return xs, ys
+
+
+def model_spec(learning_rate=0.005):
+    model = HeartDNN()
+
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1, NUM_FEATURES)))["params"]
+
+    return ModelSpec(
+        name="heart",
+        init_fn=init_fn,
+        apply_fn=lambda p, x, t: model.apply({"params": p}, x, train=t),
+        loss_fn=lambda logits, labels: optax.sigmoid_binary_cross_entropy(
+            logits, labels.astype(jnp.float32)
+        ),
+        optimizer=optax.adam(learning_rate),
+        feed=feed,
+        eval_metrics_fn=lambda: {
+            "auc": metrics.AUC(),
+            "accuracy": metrics.BinaryAccuracy(threshold=0.0),
+        },
+    )
+
+
+def synthetic_heart_csv(path, n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            x = rng.randn(NUM_FEATURES)
+            y = int(x[0] + 0.8 * x[3] - 0.5 * x[7] + rng.randn() * 0.3
+                    > 0)
+            f.write(",".join("%.3f" % v for v in x) + ",%d\n" % y)
+    return path

@@ -39,11 +39,21 @@ class ModelSpec:
     ps_optimizer: tuple = ("sgd", "learning_rate=0.1")
 
 
-def load_model_spec(module_name, **kwargs):
+def load_model_spec(module_name, model_params="", **kwargs):
     """Import a zoo module and build its ModelSpec.
 
-    ``module_name`` may be a short zoo name ("mnist") or a full dotted path.
+    ``module_name`` may be a short zoo name ("mnist") or a full dotted
+    path; ``model_params`` is a "k=v;k=v" string merged into kwargs
+    (ints/floats parsed; the reference's --model_def/--model_params
+    mechanism, model_utils.py:135-192).
     """
+    if model_params:
+        from elasticdl_tpu.utils.args import parse_opt_args
+
+        for key, value in parse_opt_args(model_params).items():
+            if isinstance(value, float) and value.is_integer():
+                value = int(value)
+            kwargs.setdefault(key, value)
     if "." not in module_name:
         module_name = "elasticdl_tpu.models." + module_name
     module = importlib.import_module(module_name)

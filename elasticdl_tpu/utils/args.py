@@ -23,6 +23,9 @@ def add_common_args(parser):
                         help="output dir for predict jobs")
     parser.add_argument("--model_zoo", default="mnist",
                         help="zoo module name or dotted path")
+    parser.add_argument("--model_params", default="",
+                        help="k=v;k=v kwargs for model_spec() "
+                             "(reference --model_def/--model_params)")
     parser.add_argument("--data_origin", default="synthetic_mnist",
                         help="dataset spec: synthetic_mnist[:n], csv path, "
                              "recio dir")

@@ -40,7 +40,8 @@ def build_worker(args):
     grpc_utils.wait_for_channel_ready(channel)
     mc = MasterClient(channel, worker_id=worker_id)
 
-    spec = load_model_spec(args.model_zoo)
+    spec = load_model_spec(args.model_zoo,
+                           model_params=args.model_params)
     records_per_task = args.batch_size * args.num_minibatches_per_task
     reader = create_data_reader(
         args.data_origin, records_per_shard=records_per_task

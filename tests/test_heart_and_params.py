@@ -1,0 +1,35 @@
+import numpy as np
+
+from elasticdl_tpu.models import heart
+from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+
+def test_heart_learns(tmp_path):
+    path = heart.synthetic_heart_csv(str(tmp_path / "heart.csv"), n=256)
+    with open(path) as f:
+        records = [line.strip().split(",") for line in f]
+    spec = heart.model_spec(learning_rate=0.02)
+    trainer = CollectiveTrainer(spec, batch_size=64)
+    for _ in range(10):
+        for i in range(0, 256, 64):
+            xs, ys = spec.feed(records[i:i + 64])
+            trainer.train_minibatch(xs, ys)
+    correct, total = 0, 0
+    for i in range(0, 256, 64):
+        xs, ys = spec.feed(records[i:i + 64])
+        out, labels = trainer.evaluate_minibatch(xs, ys)
+        correct += ((out > 0) == labels).sum()
+        total += len(labels)
+    assert correct / total > 0.8
+
+
+def test_model_params_string_reaches_spec():
+    spec = load_model_spec(
+        "transformer",
+        model_params="vocab_size=128;dim=32;num_heads=2;num_layers=1;"
+                     "seq_len=16",
+    )
+    assert spec.config.vocab_size == 128
+    assert spec.config.dim == 32
+    assert spec.config.num_layers == 1
