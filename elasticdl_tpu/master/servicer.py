@@ -33,6 +33,7 @@ class MasterServicer:
         self._version = 0
         self.training_params = None
         self.worker_record_counts = {}  # worker_id -> records processed
+        self.worker_exec_counters = {}  # counter name -> total
 
     @property
     def model_version(self):
@@ -58,6 +59,14 @@ class MasterServicer:
 
     def report_task_result(self, request, _context=None):
         success = not request.err_message
+        if request.exec_counters:
+            # job-level execution counters piggybacked on task reports
+            # (reference data_shard_service.py:100-109)
+            with self._lock:
+                for name, value in request.exec_counters.items():
+                    self.worker_exec_counters[name] = max(
+                        self.worker_exec_counters.get(name, 0), value
+                    )
         result = self._task_manager.report(
             request.task_id, success, request.err_message
         )

@@ -85,9 +85,7 @@ class ParameterServerTrainer(Trainer):
             )
             initialized, version, dense = self._ps.pull_dense_parameters(-1)
         if dense:
-            self._params = unflatten_from_names(
-                to_numpy(self._params), dense
-            )
+            self._merge_dense(dense)
         self._version = version
 
     def _pull_dense(self):
@@ -105,10 +103,18 @@ class ParameterServerTrainer(Trainer):
                 self._push_model_to_init()
                 return
             if dense:
-                self._params = unflatten_from_names(
-                    to_numpy(self._params), dense
-                )
+                self._merge_dense(dense)
             self._version = version
+
+    def _merge_dense(self, dense):
+        """Merge a (possibly partial) dense pull into local params — a
+        freshly restored shard can lag the others and return only its
+        slice, or nothing at all."""
+        named, _ = flatten_with_names(to_numpy(self._params))
+        named.update(dense)
+        self._params = unflatten_from_names(
+            to_numpy(self._params), named
+        )
 
     # -- embedding plumbing -------------------------------------------------
 

@@ -7,6 +7,8 @@ evaluation results go to the master's evaluation service; the train-end
 callback task runs model-export callbacks on exactly one worker.
 """
 
+import time
+
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.timing import Timing
@@ -66,6 +68,10 @@ class Worker:
                 logger.warning(
                     "minibatch failed (attempt %d): %s", attempt + 1, e
                 )
+                # Exponential backoff so the retry budget rides out
+                # transient outages (a PS shard relaunching takes
+                # seconds; 64 instant retries would burn out in <1s).
+                time.sleep(min(0.1 * (2 ** min(attempt, 5)), 3.0))
         raise RuntimeError(
             "minibatch failed after %d retries" % self._max_minibatch_retries
         ) from err
