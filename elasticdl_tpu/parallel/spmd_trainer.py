@@ -78,3 +78,36 @@ class SPMDTrainer:
 
     def eval_loss(self, batch):
         return self._eval(self.params, self.put_batch(batch))
+
+    # -- checkpointing -------------------------------------------------------
+
+    def save_checkpoint(self, saver):
+        """Gather the (model-parallel) params to host and write one
+        versioned checkpoint; restore re-shards onto the current mesh, so
+        save/restore doubles as the resize path for tp/pp/ep layouts."""
+        from elasticdl_tpu.utils.pytree import (
+            flatten_with_names,
+            to_numpy,
+        )
+
+        named, _ = flatten_with_names(to_numpy(self.params))
+        saver.save(self.version, dense=named)
+
+    def restore_checkpoint(self, saver):
+        from elasticdl_tpu.utils.pytree import (
+            to_numpy,
+            unflatten_from_names,
+        )
+
+        dense, _, version = saver.load()
+        restored = unflatten_from_names(to_numpy(self.params), dense)
+        # re-shard onto the current mesh via the committed shardings
+        shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding, self.params
+        )
+        self.params = jax.tree_util.tree_map(
+            jax.device_put, restored, shardings
+        )
+        self.opt_state = jax.jit(self._tx.init)(self.params)
+        self.version = version
+        return version
