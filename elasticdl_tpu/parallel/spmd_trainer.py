@@ -11,6 +11,7 @@ param shardings onto Adam's mu/nu without hand-annotating optax internals.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -44,8 +45,12 @@ class SPMDTrainer:
         self.params = jax.tree_util.tree_map(
             jax.device_put, params, shardings
         )
-        # opt-state shardings follow the params via GSPMD propagation
-        self.opt_state = jax.jit(self._tx.init)(self.params)
+        # Optimizer-state shardings mirror the params: optax moment trees
+        # (mu/nu/trace/...) are param-shaped, so each opt leaf whose name
+        # ends with a param's name adopts that param's sharding; scalars
+        # (step counts) replicate.  (jit(tx.init) alone is not reliable
+        # here — its outputs can come back single-device-committed.)
+        self.opt_state = self._shard_opt_state(self._tx.init(params))
         self.version = 0
 
         def step(params, opt_state, batch):
@@ -62,6 +67,35 @@ class SPMDTrainer:
             return self._loss_fn(params, batch)
 
         self._eval = jax.jit(eval_loss)
+
+    def _shard_opt_state(self, opt_state):
+        """device_put an (unsharded/host) opt-state tree with shardings
+        derived from the param shardings by name suffix match."""
+        from elasticdl_tpu.utils.pytree import flatten_with_names
+
+        param_shardings = {
+            name: leaf.sharding
+            for name, leaf in flatten_with_names(self.params)[0].items()
+        }
+        replicated = NamedSharding(self.mesh, P())
+        named, _ = flatten_with_names(opt_state)
+        placed = {}
+        for name, leaf in named.items():
+            sharding = replicated
+            for pname, psharding in param_shardings.items():
+                if name == pname or name.endswith("/" + pname):
+                    sharding = psharding
+                    break
+            placed[name] = jax.device_put(np.asarray(leaf), sharding)
+        # rebuild the tree with the placed leaves
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+        from elasticdl_tpu.utils.pytree import _key_name
+
+        new_leaves = []
+        for path, leaf in leaves:
+            name = "/".join(_key_name(k) for k in path) or "param"
+            new_leaves.append(placed[name])
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     def put_batch(self, batch):
         return jax.tree_util.tree_map(
@@ -82,25 +116,41 @@ class SPMDTrainer:
     # -- checkpointing -------------------------------------------------------
 
     def save_checkpoint(self, saver):
-        """Gather the (model-parallel) params to host and write one
-        versioned checkpoint; restore re-shards onto the current mesh, so
-        save/restore doubles as the resize path for tp/pp/ep layouts."""
+        """Gather the (model-parallel) params AND optimizer state to host
+        and write one versioned checkpoint; restore re-shards onto the
+        current mesh, so save/restore doubles as the resize path for
+        tp/pp/ep layouts."""
         from elasticdl_tpu.utils.pytree import (
             flatten_with_names,
             to_numpy,
         )
 
         named, _ = flatten_with_names(to_numpy(self.params))
-        saver.save(self.version, dense=named)
+        opt_named, _ = flatten_with_names(to_numpy(self.opt_state))
+        payload = dict(named)
+        payload.update(
+            {"opt/" + k: v for k, v in opt_named.items()}
+        )
+        saver.save(self.version, dense=payload)
 
     def restore_checkpoint(self, saver):
         from elasticdl_tpu.utils.pytree import (
+            flatten_with_names,
             to_numpy,
             unflatten_from_names,
         )
 
         dense, _, version = saver.load()
-        restored = unflatten_from_names(to_numpy(self.params), dense)
+        params_named = {
+            k: v for k, v in dense.items() if not k.startswith("opt/")
+        }
+        opt_named = {
+            k[len("opt/"):]: v for k, v in dense.items()
+            if k.startswith("opt/")
+        }
+        restored = unflatten_from_names(
+            to_numpy(self.params), params_named
+        )
         # re-shard onto the current mesh via the committed shardings
         shardings = jax.tree_util.tree_map(
             lambda a: a.sharding, self.params
@@ -108,6 +158,16 @@ class SPMDTrainer:
         self.params = jax.tree_util.tree_map(
             jax.device_put, restored, shardings
         )
-        self.opt_state = jax.jit(self._tx.init)(self.params)
+        if opt_named:
+            # full training-state round-trip: Adam moments / schedule
+            # counters survive failover and resize
+            opt_restored = unflatten_from_names(
+                to_numpy(self.opt_state), opt_named
+            )
+            self.opt_state = self._shard_opt_state(opt_restored)
+        else:
+            self.opt_state = self._shard_opt_state(
+                self._tx.init(to_numpy(self.params))
+            )
         self.version = version
         return version
