@@ -19,7 +19,6 @@ RoPE positions, pre-norm RMSNorm, SwiGLU MLP.
 """
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp

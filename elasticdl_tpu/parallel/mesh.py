@@ -5,7 +5,6 @@ TPU-native framework makes the mesh a first-class object: axes are chosen
 once, shardings are annotated, and XLA inserts the collectives over ICI.
 """
 
-import math
 
 import jax
 import numpy as np

@@ -10,7 +10,6 @@ param shardings onto Adam's mu/nu without hand-annotating optax internals.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P

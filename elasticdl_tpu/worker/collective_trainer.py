@@ -11,7 +11,6 @@ changes with the world size.  Rebuilding for a new mesh = re-sharding params
 and re-jitting — the compile cache keyed by (mesh shape, accum steps).
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp
