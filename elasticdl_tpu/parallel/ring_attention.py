@@ -98,6 +98,14 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if mesh is None or mesh.shape.get(sp_axis, 1) == 1:
         return attention_local(q, k, v, causal=causal, scale=scale)
+    sp = mesh.shape[sp_axis]
+    tp = mesh.shape.get(tp_axis, 1)
+    if q.shape[1] % sp or q.shape[2] % tp:
+        raise ValueError(
+            "ring attention needs seq (%d) divisible by sp=%d and heads "
+            "(%d) divisible by tp=%d; pad the sequence or adjust the "
+            "mesh" % (q.shape[1], sp, q.shape[2], tp)
+        )
     spec = P(dp_axis, sp_axis, tp_axis, None)
     fn = shard_map(
         functools.partial(
