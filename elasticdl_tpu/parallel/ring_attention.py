@@ -100,12 +100,16 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
         return attention_local(q, k, v, causal=causal, scale=scale)
     sp = mesh.shape[sp_axis]
     tp = mesh.shape.get(tp_axis, 1)
-    if q.shape[1] % sp or q.shape[2] % tp:
-        raise ValueError(
-            "ring attention needs seq (%d) divisible by sp=%d and heads "
-            "(%d) divisible by tp=%d; pad the sequence or adjust the "
-            "mesh" % (q.shape[1], sp, q.shape[2], tp)
-        )
+    dp = mesh.shape.get(dp_axis, 1)
+    for name, arr in (("q", q), ("k", k), ("v", v)):
+        if arr.shape[0] % dp or arr.shape[1] % sp or arr.shape[2] % tp:
+            raise ValueError(
+                "ring attention needs %s dims [batch=%d, seq=%d, "
+                "heads=%d] divisible by [dp=%d, sp=%d, tp=%d]; pad the "
+                "inputs or adjust the mesh"
+                % (name, arr.shape[0], arr.shape[1], arr.shape[2],
+                   dp, sp, tp)
+            )
     spec = P(dp_axis, sp_axis, tp_axis, None)
     fn = shard_map(
         functools.partial(
