@@ -45,10 +45,16 @@ class RecioDataReader(AbstractDataReader):
         return self._readers[name]
 
     def create_shards(self):
+        from elasticdl_tpu.data.recio import MAGIC
+
         shards = []
         for path in sorted(glob.glob(os.path.join(self._data_dir, "*"))):
-            if os.path.isfile(path):
-                shards.append((path, 0, len(self._reader(path))))
+            if not os.path.isfile(path):
+                continue
+            with open(path, "rb") as f:
+                if f.read(4) != MAGIC:
+                    continue  # skip non-recio files in mixed dirs
+            shards.append((path, 0, len(self._reader(path))))
         return shards
 
     def read_records(self, task):
