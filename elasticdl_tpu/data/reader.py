@@ -59,7 +59,13 @@ class RecioDataReader(AbstractDataReader):
 
     def read_records(self, task):
         reader = self._reader(task.shard.name)
-        for payload in reader.read_range(task.shard.start, task.shard.end):
+        if task.shard.record_indices:
+            # Shuffled task: the offset index gives O(1) random access, so
+            # honor the master's permutation instead of the linear range.
+            records = (reader.read(i) for i in task.shard.record_indices)
+        else:
+            records = reader.read_range(task.shard.start, task.shard.end)
+        for payload in records:
             yield self._decode_fn(payload) if self._decode_fn else payload
 
 
@@ -95,16 +101,15 @@ class TextDataReader(AbstractDataReader):
         return shards
 
     def read_records(self, task):
-        start, end = task.shard.start, task.shard.end
-        end = min(end, len(self._offsets))
-        if start >= end:
-            return
-        self._f.seek(self._offsets[start])
+        indices = task.shard.record_indices or range(
+            task.shard.start, min(task.shard.end, len(self._offsets))
+        )
         lines = []
-        for _ in range(end - start):
-            lines.append(self._f.readline().decode("utf-8"))
-        for row in csv.reader(lines):
-            yield row
+        for i in indices:
+            if i < len(self._offsets):
+                self._f.seek(self._offsets[i])
+                lines.append(self._f.readline().decode("utf-8"))
+        yield from csv.reader(lines)
 
     def get_size(self):
         return len(self._offsets)

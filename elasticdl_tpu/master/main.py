@@ -69,7 +69,9 @@ def build_master(args):
         # previous run stopped.
         from elasticdl_tpu.utils.checkpoint import CheckpointSaver
 
-        latest = CheckpointSaver(args.checkpoint_dir).latest_version()
+        latest = CheckpointSaver(
+            args.checkpoint_dir
+        ).latest_resumable_version(max(args.num_ps, 1))
         if latest:
             task_manager.skip_records(latest * args.batch_size)
     spec = load_model_spec(args.model_zoo,

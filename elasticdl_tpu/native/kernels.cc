@@ -5,9 +5,13 @@
 // go/pkg/common/embedding_table.go:22-88 for the table) — written fresh in
 // C++17.  Dense kernels are flat SIMD-friendly loops over contiguous
 // buffers (g++ -O3 -march=native auto-vectorizes them); the embedding
-// store is an open-addressed-ish unordered_map of id -> row with a
-// reader/writer lock and lazy per-id initialization, so sparse
-// pulls/pushes from many gRPC threads proceed concurrently.
+// store is an unordered_map of id -> row guarded by a reader/writer lock
+// held for the duration of each batch operation: pulls (edl_table_get)
+// run concurrently under the shared lock, while any mutation (set /
+// sparse optimizer push / lazy row init) holds the unique lock for the
+// whole batch.  That serializes pushes per table but makes concurrent
+// pull+push / push+push on the same id well-defined — no row reference
+// ever escapes the lock that protects it.
 //
 // Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
 
@@ -124,14 +128,11 @@ struct Table {
     }
   }
 
-  // Returns the row, creating + initializing it if absent.
-  std::vector<float>& get_or_init(int64_t id) {
-    {
-      std::shared_lock<std::shared_mutex> lock(mu);
-      auto it = rows.find(id);
-      if (it != rows.end()) return it->second;
-    }
-    std::unique_lock<std::shared_mutex> lock(mu);
+  // Returns the row, creating + initializing it if absent.  Caller must
+  // hold the unique lock on `mu` (the reference stays valid only while
+  // that lock is held — unordered_map rehash never invalidates element
+  // references, but concurrent writers would race on the row contents).
+  std::vector<float>& get_or_init_unlocked(int64_t id) {
     auto [it, inserted] = rows.try_emplace(id);
     if (inserted) init_row(id, it->second);
     return it->second;
@@ -166,9 +167,27 @@ int64_t edl_table_size(void* handle) {
 void edl_table_get(void* handle, const int64_t* ids, int64_t n,
                    float* out) {
   Table* t = (Table*)handle;
-  for (int64_t i = 0; i < n; ++i) {
-    const auto& row = t->get_or_init(ids[i]);
-    std::memcpy(out + i * t->dim, row.data(), t->dim * sizeof(float));
+  // Fast path: copy existing rows under the shared lock so concurrent
+  // pulls don't serialize; collect ids that need lazy init.
+  std::vector<int64_t> missing;
+  {
+    std::shared_lock<std::shared_mutex> lock(t->mu);
+    for (int64_t i = 0; i < n; ++i) {
+      auto it = t->rows.find(ids[i]);
+      if (it != t->rows.end()) {
+        std::memcpy(out + i * t->dim, it->second.data(),
+                    t->dim * sizeof(float));
+      } else {
+        missing.push_back(i);
+      }
+    }
+  }
+  if (!missing.empty()) {
+    std::unique_lock<std::shared_mutex> lock(t->mu);
+    for (int64_t i : missing) {
+      const auto& row = t->get_or_init_unlocked(ids[i]);
+      std::memcpy(out + i * t->dim, row.data(), t->dim * sizeof(float));
+    }
   }
 }
 
@@ -204,11 +223,16 @@ int64_t edl_table_export(void* handle, int64_t* out_ids, float* out_values,
 // grads: [n, dim] rows aligned with ids; slot tables hold per-id optimizer
 // state and share the main table's id space (created with kZeros init).
 
+// Each kernel holds the unique lock on the main table plus every slot
+// table for the whole batch (always acquired in argument order —
+// main, then slots — so concurrent pushes can't deadlock).
+
 void edl_table_sgd(void* handle, const int64_t* ids, int64_t n,
                    const float* grads, float lr) {
   Table* t = (Table*)handle;
+  std::unique_lock<std::shared_mutex> lock(t->mu);
   for (int64_t i = 0; i < n; ++i) {
-    auto& row = t->get_or_init(ids[i]);
+    auto& row = t->get_or_init_unlocked(ids[i]);
     edl_sgd(row.data(), grads + i * t->dim, t->dim, lr);
   }
 }
@@ -218,9 +242,11 @@ void edl_table_momentum(void* handle, void* vel_handle, const int64_t* ids,
                         int nesterov) {
   Table* t = (Table*)handle;
   Table* vt = (Table*)vel_handle;
+  std::unique_lock<std::shared_mutex> lock(t->mu);
+  std::unique_lock<std::shared_mutex> vlock(vt->mu);
   for (int64_t i = 0; i < n; ++i) {
-    auto& row = t->get_or_init(ids[i]);
-    auto& vel = vt->get_or_init(ids[i]);
+    auto& row = t->get_or_init_unlocked(ids[i]);
+    auto& vel = vt->get_or_init_unlocked(ids[i]);
     edl_momentum(row.data(), grads + i * t->dim, vel.data(), t->dim, lr,
                  mu, nesterov);
   }
@@ -234,11 +260,16 @@ void edl_table_adam(void* handle, void* m_handle, void* v_handle,
   Table* mt = (Table*)m_handle;
   Table* vt = (Table*)v_handle;
   Table* xt = (Table*)maxsq_handle;  // may be null (no amsgrad)
+  std::unique_lock<std::shared_mutex> lock(t->mu);
+  std::unique_lock<std::shared_mutex> mlock(mt->mu);
+  std::unique_lock<std::shared_mutex> vlock(vt->mu);
+  std::unique_lock<std::shared_mutex> xlock;
+  if (xt) xlock = std::unique_lock<std::shared_mutex>(xt->mu);
   for (int64_t i = 0; i < n; ++i) {
-    auto& row = t->get_or_init(ids[i]);
-    auto& m = mt->get_or_init(ids[i]);
-    auto& v = vt->get_or_init(ids[i]);
-    float* maxsq = xt ? xt->get_or_init(ids[i]).data() : nullptr;
+    auto& row = t->get_or_init_unlocked(ids[i]);
+    auto& m = mt->get_or_init_unlocked(ids[i]);
+    auto& v = vt->get_or_init_unlocked(ids[i]);
+    float* maxsq = xt ? xt->get_or_init_unlocked(ids[i]).data() : nullptr;
     edl_adam(row.data(), grads + i * t->dim, m.data(), v.data(), t->dim,
              lr, beta1, beta2, eps, step, maxsq);
   }
@@ -248,9 +279,11 @@ void edl_table_adagrad(void* handle, void* accum_handle, const int64_t* ids,
                        int64_t n, const float* grads, float lr, float eps) {
   Table* t = (Table*)handle;
   Table* at = (Table*)accum_handle;
+  std::unique_lock<std::shared_mutex> lock(t->mu);
+  std::unique_lock<std::shared_mutex> alock(at->mu);
   for (int64_t i = 0; i < n; ++i) {
-    auto& row = t->get_or_init(ids[i]);
-    auto& accum = at->get_or_init(ids[i]);
+    auto& row = t->get_or_init_unlocked(ids[i]);
+    auto& accum = at->get_or_init_unlocked(ids[i]);
     edl_adagrad(row.data(), grads + i * t->dim, accum.data(), t->dim, lr,
                 eps);
   }

@@ -57,6 +57,31 @@ class Optimizer:
     def _slot_table(self, params, table_name, slot):
         return params.slot_tables[slot_table_name(table_name, slot)]
 
+    # -- checkpoint ---------------------------------------------------------
+
+    def slots_to_payload(self):
+        """Dense slot state + step counter for checkpoints.
+
+        The reference Go PS persists slot state as shadow models inside the
+        checkpoint (go/pkg/ps/optimizer.go:43-73 slot models +
+        checkpoint.go:136-141); without this an Adam restore silently
+        resets m/v to zero and bias correction to step 1.
+        """
+        payload = {"__step__": np.array([self.step], np.int64)}
+        for (name, slot), arr in self._dense_slots.items():
+            payload["%s@%s" % (name, slot)] = arr.copy()
+        return payload
+
+    def restore_slots_from_payload(self, payload):
+        for key, arr in payload.items():
+            if key == "__step__":
+                self.step = int(np.asarray(arr).reshape(-1)[0])
+            else:
+                name, slot = key.rsplit("@", 1)
+                self._dense_slots[(name, slot)] = np.array(
+                    arr, np.float32, copy=True
+                )
+
 
 class SGD(Optimizer):
     def apply_dense(self, name, param, grad, lr):

@@ -59,6 +59,12 @@ class ParameterServer:
         except FileNotFoundError:
             logger.warning("no checkpoint to restore in %s", ckpt_dir)
             return
+        slot_payload = {
+            k[len("optslot/"):]: dense.pop(k)
+            for k in [k for k in dense if k.startswith("optslot/")]
+        }
+        if slot_payload:
+            self.optimizer.restore_slots_from_payload(slot_payload)
         infos = [
             {"name": n, "dim": v[1].shape[1]}
             for n, v in embeddings.items()

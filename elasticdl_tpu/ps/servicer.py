@@ -182,6 +182,11 @@ class PserverServicer:
             and v % self._checkpoint_steps == 0
         ):
             dense, embeddings = self._params.to_checkpoint_payload()
+            # Dense optimizer slot state rides along under an "optslot/"
+            # prefix so a restored shard resumes Adam/Momentum trajectories
+            # (the embedding slot tables are already in the payload).
+            for key, arr in self._opt.slots_to_payload().items():
+                dense["optslot/" + key] = arr
             self._checkpoint_saver.save_shard(
                 v, self._ps_id, self._num_ps,
                 dense=dense, embeddings=embeddings,

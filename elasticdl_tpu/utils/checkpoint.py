@@ -11,6 +11,24 @@ Shard routing matches utils/hashing.py (dense by name hash, embeddings by
 id mod N) so any shard count can be re-read by any other shard count.
 Payload per shard is a numpy .npz (named dense arrays + per-table id/value
 pairs), not protobuf — zero-copy friendly on the JAX side.
+
+With num_ps > 1 the shards' version counters drift (pushes can skip a
+shard; sync rejections are per-shard), so requiring all N files under one
+version dir could leave zero restorable checkpoints.  Restore therefore
+falls back to *per-shard* validity: a shard restarting with an unchanged
+shard count loads its own newest ``variables-i-of-N.ckpt`` even if the
+sibling shards checkpointed under different version labels.  That matches
+async-PS semantics — shard versions are independent counters and a
+globally consistent cut never exists in the first place.  Only a shard-
+count *change* requires a fully-valid version (all N files, so rows can be
+re-hash-routed).  GC is likewise per-shard: each shard prunes its own old
+files and removes version dirs it leaves empty, so drifting labels can't
+accumulate torn dirs forever.
+
+Dense optimizer slot state is stored under ``optslot/<param>@<slot>`` (plus
+``optslot/__step__``); on cross-shard re-routing a slot follows its parent
+parameter's hash so Adam state always lands on the shard that owns the
+parameter.
 """
 
 import os
@@ -47,7 +65,7 @@ class CheckpointSaver:
 
     def save_shard(
         self, version, shard_index, num_shards,
-        dense=None, embeddings=None,
+        dense=None, embeddings=None, gc=True,
     ):
         """Write one shard of one version.
 
@@ -66,8 +84,8 @@ class CheckpointSaver:
         with open(tmp, "wb") as f:
             np.savez(f, **payload)
         os.replace(tmp, path)
-        if shard_index == 0:
-            self._gc()
+        if gc:
+            self._gc_shard(shard_index, num_shards)
         return path
 
     def save(self, version, dense=None, embeddings=None, num_shards=1):
@@ -75,16 +93,20 @@ class CheckpointSaver:
         for i in range(num_shards):
             shard_dense = {
                 k: v for k, v in (dense or {}).items()
-                if hashing.string_to_id(k, num_shards) == i
+                if self._dense_shard(k, num_shards) in (i, None)
             }
             shard_emb = {}
             for name, (ids, values) in (embeddings or {}).items():
                 ids = np.asarray(ids, dtype=np.int64)
                 sel = ids % num_shards == i
                 shard_emb[name] = (ids[sel], np.asarray(values)[sel])
+            # Defer GC to a single pass after the last shard lands — N
+            # tree scans per save (the first N-1 against a deliberately
+            # torn in-progress version) are pure waste.
             self.save_shard(
                 version, i, num_shards,
                 dense=shard_dense, embeddings=shard_emb,
+                gc=(i == num_shards - 1),
             )
 
     # -- read ---------------------------------------------------------------
@@ -103,18 +125,67 @@ class CheckpointSaver:
         versions = self.versions()
         return versions[-1] if versions else None
 
+    def latest_resumable_version(self, num_shards):
+        """Newest version any shard of an (unchanged) num_shards layout
+        could restore from — the max over fully-valid versions and every
+        shard's own per-shard versions.  The master uses this for its
+        skip-records resume math so it agrees with what the PS shards
+        will actually restore via ``load_shard(None, ...)``."""
+        candidates = [v for v in (self.latest_version(),) if v is not None]
+        for i in range(num_shards):
+            own = self.shard_versions(i, num_shards)
+            if own:
+                candidates.append(own[-1])
+        return max(candidates) if candidates else None
+
     def is_valid_version(self, version):
+        """A version is valid iff, for some layout N, all N of its
+        ``variables-*-of-N.ckpt`` files are present.  Grouping by layout
+        means a leftover file from a pre-resize shard count can't
+        permanently poison a label that a complete new-layout write later
+        reuses."""
+        return self._complete_layout(version) is not None
+
+    def _complete_layout(self, version):
+        """Return the shard count N of the most recently *written*
+        complete layout under this version dir, or None.  Recency (file
+        mtime), not layout size, breaks ties so a label reused after a
+        resize resolves to the newer fleet's data."""
         vdir = _version_dir(self._dir, version)
         if not os.path.isdir(vdir):
-            return False
-        shard_counts = set()
-        files = 0
+            return None
+        by_layout = {}
         for entry in os.listdir(vdir):
             m = _SHARD_RE.search(entry)
             if m:
-                files += 1
-                shard_counts.add(int(m.group(2)))
-        return len(shard_counts) == 1 and files == shard_counts.pop()
+                by_layout.setdefault(int(m.group(2)), set()).add(
+                    int(m.group(1))
+                )
+        best, best_mtime = None, None
+        for n, shards in by_layout.items():
+            if shards != set(range(n)):
+                continue
+            mtime = max(
+                os.path.getmtime(_shard_file(self._dir, version, i, n))
+                for i in range(n)
+            )
+            if best is None or mtime > best_mtime:
+                best, best_mtime = n, mtime
+        return best
+
+    @staticmethod
+    def _read_shard_file(path):
+        """Parse one shard .npz into (dense, embeddings) — the single
+        payload-format parser shared by every read path."""
+        dense, embeddings = {}, {}
+        with np.load(path) as z:
+            for key in z.files:
+                kind, name = key.split("/", 1)
+                if kind == "dense":
+                    dense[name] = z[key]
+                elif kind == "emb_ids":
+                    embeddings[name] = (z[key], z["emb_vals/" + name])
+        return dense, embeddings
 
     def load(self, version=None):
         """Load all shards of a version.
@@ -125,39 +196,88 @@ class CheckpointSaver:
             version = self.latest_version()
         if version is None:
             raise FileNotFoundError("no valid checkpoint in %s" % self._dir)
-        vdir = _version_dir(self._dir, version)
+        layout = self._complete_layout(version)
+        if layout is None:
+            raise FileNotFoundError(
+                "version-%d in %s is torn" % (version, self._dir)
+            )
         dense = {}
         embeddings = {}
-        for entry in sorted(os.listdir(vdir)):
-            if not _SHARD_RE.search(entry):
-                continue
-            with np.load(os.path.join(vdir, entry)) as z:
-                for key in z.files:
-                    kind, name = key.split("/", 1)
-                    if kind == "dense":
-                        dense[name] = z[key]
-                    elif kind == "emb_ids":
-                        ids = z[key]
-                        values = z["emb_vals/" + name]
-                        if name in embeddings:
-                            prev_ids, prev_vals = embeddings[name]
-                            ids = np.concatenate([prev_ids, ids])
-                            values = np.concatenate([prev_vals, values])
-                        embeddings[name] = (ids, values)
+        for i in range(layout):
+            shard_dense, shard_emb = self._read_shard_file(
+                _shard_file(self._dir, version, i, layout)
+            )
+            for name, arr in shard_dense.items():
+                if name == "optslot/__step__" and name in dense:
+                    # Shard step counters drift in async mode; keep the
+                    # max so Adam bias correction never moves backward.
+                    dense[name] = np.maximum(dense[name], arr)
+                else:
+                    dense[name] = arr
+            for name, (ids, values) in shard_emb.items():
+                if name in embeddings:
+                    prev_ids, prev_vals = embeddings[name]
+                    ids = np.concatenate([prev_ids, ids])
+                    values = np.concatenate([prev_vals, values])
+                embeddings[name] = (ids, values)
         return dense, embeddings, version
 
+    def shard_versions(self, shard_index, num_shards):
+        """Versions that contain this exact shard file (per-shard validity)."""
+        out = []
+        if not os.path.isdir(self._dir):
+            return out
+        for entry in os.listdir(self._dir):
+            m = re.match(r"version-(\d+)$", entry)
+            if m and os.path.isfile(
+                _shard_file(self._dir, int(m.group(1)),
+                            shard_index, num_shards)
+            ):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
     def load_shard(self, version, shard_index, num_shards):
-        """Re-route a stored version onto shard_index of a new shard count."""
+        """Load shard_index's slice of a stored version.
+
+        With ``version=None``, pick whichever is newer by version label:
+        the newest fully-valid version (re-hash-routable across any shard
+        count) or this shard's own newest file under the unchanged (i, N)
+        layout — so a fully-valid label from early in the job can never
+        silently roll a shard back past its own later checkpoints.
+        """
+        if version is None:
+            own = self.shard_versions(shard_index, num_shards)
+            full = self.latest_version()
+            if not own and full is None:
+                raise FileNotFoundError(
+                    "no valid checkpoint in %s" % self._dir
+                )
+            if own and (full is None or own[-1] > full):
+                v = own[-1]
+                dense, embeddings = self._read_shard_file(
+                    _shard_file(self._dir, v, shard_index, num_shards)
+                )
+                return dense, embeddings, v
         dense, embeddings, version = self.load(version)
         my_dense = {
             k: v for k, v in dense.items()
-            if hashing.string_to_id(k, num_shards) == shard_index
+            if self._dense_shard(k, num_shards) in (shard_index, None)
         }
         my_emb = {}
         for name, (ids, values) in embeddings.items():
             sel = ids % num_shards == shard_index
             my_emb[name] = (ids[sel], values[sel])
         return my_dense, my_emb, version
+
+    @staticmethod
+    def _dense_shard(key, num_shards):
+        """Dense routing; optimizer slots follow their parent parameter
+        and the step counter replicates to every shard."""
+        if key == "optslot/__step__":
+            return None  # caller treats None as "all shards"
+        if key.startswith("optslot/"):
+            key = key[len("optslot/"):].rsplit("@", 1)[0]
+        return hashing.string_to_id(key, num_shards)
 
     # -- gc -----------------------------------------------------------------
 
@@ -167,3 +287,63 @@ class CheckpointSaver:
             victim = versions.pop(0)
             shutil.rmtree(_version_dir(self._dir, victim), ignore_errors=True)
             logger.info("checkpoint GC: removed version-%d", victim)
+
+    def _gc_shard(self, shard_index, num_shards):
+        """Three-stage GC run after each shard write:
+
+        1. fully-valid versions beyond keep_max are removed whole (the
+           classic reference GC, save_utils.py:229-294 semantics);
+        2. this shard's own older files beyond keep_max are pruned —
+           except from any surviving fully-valid version, so a
+           shard-count-change restore is never torn by GC;
+        3. stale-layout files (``-of-M`` with M != num_shards) in dirs
+           older than the newest fully-valid version are swept, so a
+           resize can't strand undeletable dirs forever.
+
+        Dirs left empty are removed; the last shard out deletes the dir.
+        """
+        self._gc()
+        protected = set(self.versions())
+        versions = [
+            v for v in self.shard_versions(shard_index, num_shards)
+            if v not in protected
+        ]
+        for victim in versions[: -self._keep_max] if self._keep_max else []:
+            path = _shard_file(self._dir, victim, shard_index, num_shards)
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            try:
+                os.rmdir(_version_dir(self._dir, victim))
+                logger.info("checkpoint GC: removed version-%d", victim)
+            except OSError:
+                pass  # other shards' files still present
+        newest_valid = max(protected) if protected else None
+        if newest_valid is not None:
+            self._gc_stale_layouts(num_shards, newest_valid, protected)
+
+    def _gc_stale_layouts(self, num_shards, newest_valid, protected):
+        """Remove pre-resize layout files from non-protected dirs older
+        than the newest fully-valid version (superseded by it)."""
+        for entry in os.listdir(self._dir):
+            m = re.match(r"version-(\d+)$", entry)
+            if (
+                not m
+                or int(m.group(1)) >= newest_valid
+                or int(m.group(1)) in protected
+            ):
+                continue
+            vdir = os.path.join(self._dir, entry)
+            for fname in os.listdir(vdir):
+                fm = _SHARD_RE.search(fname)
+                if fm and int(fm.group(2)) != num_shards:
+                    try:
+                        os.remove(os.path.join(vdir, fname))
+                    except OSError:
+                        pass
+            try:
+                os.rmdir(vdir)
+                logger.info("checkpoint GC: removed stale %s", entry)
+            except OSError:
+                pass

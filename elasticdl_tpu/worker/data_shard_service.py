@@ -75,10 +75,16 @@ class DataShardService:
     def report_task_failed(self, task, err_message):
         with self._lock:
             try:
+                was_head = self._pending and self._pending[0] is task
                 self._pending.remove(task)
-                # Drop records consumed from the abandoned task so they
-                # don't count toward the next task's completion.
-                self._record_count = 0
+                # Consumption is FIFO against the head task, so only a
+                # failed head can have records counted toward it — drop at
+                # most its own share, never progress that belongs to other
+                # pending tasks.
+                if was_head:
+                    self._record_count = max(
+                        0, self._record_count - task.size
+                    )
             except ValueError:
                 pass
         self._mc.report_task_result(task.id, err_message=err_message)

@@ -1,0 +1,58 @@
+"""DataShardService record accounting (ADVICE r1: report_task_failed wiped
+progress belonging to other pending tasks)."""
+
+from types import SimpleNamespace
+
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.worker.data_shard_service import DataShardService
+
+
+class FakeMasterClient:
+    def __init__(self, sizes):
+        self._tasks = [
+            SimpleNamespace(
+                id=i, type=pb.TRAINING,
+                shard=SimpleNamespace(name="s", start=0, end=size,
+                                      record_indices=[]),
+                model_version=-1,
+            )
+            for i, size in enumerate(sizes)
+        ]
+        self.results = []  # (task_id, err_message)
+
+    def get_task(self, task_type=None):
+        if self._tasks:
+            return self._tasks.pop(0)
+        return SimpleNamespace(id=-1, type=pb.NONE, shard=None,
+                               model_version=-1)
+
+    def report_batch_done(self, count):
+        pass
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        self.results.append((task_id, err_message))
+
+
+def test_failed_head_drops_only_its_own_records():
+    mc = FakeMasterClient([10, 10])
+    svc = DataShardService(mc, batch_size=5)
+    t0 = svc.fetch_task()
+    t1 = svc.fetch_task()
+    svc.report_batch_done(5)            # 5 records into t0
+    svc.report_task_failed(t0, "boom")  # head fails
+    assert svc._record_count == 0
+    svc.report_batch_done(5)
+    svc.report_batch_done(5)            # t1's 10 records complete it
+    assert (t1.id, "") in mc.results
+
+
+def test_failed_non_head_preserves_head_progress():
+    mc = FakeMasterClient([10, 10])
+    svc = DataShardService(mc, batch_size=5)
+    t0 = svc.fetch_task()
+    t1 = svc.fetch_task()
+    svc.report_batch_done(5)            # 5 records counted toward t0 (head)
+    svc.report_task_failed(t1, "boom")  # NOT the head
+    assert svc._record_count == 5       # t0's progress survives
+    svc.report_batch_done(5)            # t0 completes
+    assert (t0.id, "") in mc.results

@@ -59,3 +59,37 @@ def test_relaunched_adam_ps_applies_sparse_pushes(tmp_path):
         assert accepted and version == 2
     finally:
         ps2.stop()
+
+
+def test_restored_dense_adam_matches_uninterrupted_trajectory(tmp_path):
+    """Dense Adam m/v/step survive a PS relaunch (ADVICE r1: they silently
+    reset to zero): a restored shard applies the next push identically to a
+    shard that never died."""
+    grad0 = np.full(4, 0.5, np.float32)
+    grad1 = np.full(4, -0.25, np.float32)
+
+    # Uninterrupted trajectory.
+    ps_ref, client_ref = make_ps(tmp_path / "ref")
+    client_ref.push_model({"w": np.ones(4, np.float32)})
+    client_ref.push_gradients({"w": grad0}, {}, version=0)
+    client_ref.push_gradients({"w": grad1}, {}, version=1)
+    want = ps_ref.parameters.dense["w"].copy()
+    ps_ref.stop()
+
+    # Killed-after-step-1 + restored trajectory.
+    ps1, client1 = make_ps(tmp_path / "elastic")
+    client1.push_model({"w": np.ones(4, np.float32)})
+    client1.push_gradients({"w": grad0}, {}, version=0)
+    assert ps1.optimizer.step == 1
+    ps1.stop()
+
+    ps2, client2 = make_ps(tmp_path / "elastic", restore=True)
+    try:
+        assert ps2.optimizer.step == 1  # step counter restored
+        assert ps2.optimizer._dense_slots  # m/v restored, not reset
+        client2.push_gradients({"w": grad1}, {}, version=1)
+        np.testing.assert_allclose(
+            ps2.parameters.dense["w"], want, rtol=1e-6
+        )
+    finally:
+        ps2.stop()

@@ -12,3 +12,29 @@ def test_recio_roundtrip(tmp_path):
         assert [r.read(i) for i in range(4)] == records
         assert list(r.read_range(1, 3)) == records[1:3]
         assert list(r.read_range(2, 99)) == records[2:]
+
+
+def test_readers_honor_shuffled_record_indices(tmp_path):
+    """File readers iterate shard.record_indices when the TaskManager sets
+    them (ADVICE r1: shuffle=True was silently a no-op for file readers)."""
+    from elasticdl_tpu.data.reader import RecioDataReader, TextDataReader
+    from elasticdl_tpu.master.task_manager import Shard, Task
+
+    path = str(tmp_path / "data.recio")
+    with RecioWriter(path) as w:
+        for i in range(6):
+            w.write(b"rec%d" % i)
+    reader = RecioDataReader(str(tmp_path))
+    order = [4, 1, 5, 2]
+    task = Task(0, Shard(path, 1, 5, record_indices=order), 0)
+    got = list(reader.read_records(task))
+    assert got == [b"rec4", b"rec1", b"rec5", b"rec2"]
+
+    csv_path = str(tmp_path / "data.csv")
+    with open(csv_path, "w") as f:
+        for i in range(6):
+            f.write("row%d,%d\n" % (i, i))
+    treader = TextDataReader(csv_path, records_per_task=3)
+    task = Task(0, Shard(csv_path, 0, 4, record_indices=[3, 0, 2]), 0)
+    got = list(treader.read_records(task))
+    assert got == [["row3", "3"], ["row0", "0"], ["row2", "2"]]
