@@ -181,16 +181,22 @@ class PserverServicer:
             and self._checkpoint_steps
             and v % self._checkpoint_steps == 0
         ):
-            dense, embeddings = self._params.to_checkpoint_payload()
-            # Dense optimizer slot state rides along under an "optslot/"
-            # prefix so a restored shard resumes Adam/Momentum trajectories
-            # (the embedding slot tables are already in the payload).
-            for key, arr in self._opt.slots_to_payload().items():
-                dense["optslot/" + key] = arr
-            self._checkpoint_saver.save_shard(
-                v, self._ps_id, self._num_ps,
-                dense=dense, embeddings=embeddings,
-            )
+            try:
+                dense, embeddings = self._params.to_checkpoint_payload()
+                # Dense optimizer slot state rides along under an
+                # "optslot/" prefix so a restored shard resumes
+                # Adam/Momentum trajectories (the embedding slot tables
+                # are already in the payload).
+                for key, arr in self._opt.slots_to_payload().items():
+                    dense["optslot/" + key] = arr
+                self._checkpoint_saver.save_shard(
+                    v, self._ps_id, self._num_ps,
+                    dense=dense, embeddings=embeddings,
+                )
+            except OSError as e:
+                # Sibling shards GC concurrently; a lost checkpoint must
+                # never fail the worker's push RPC.
+                logger.warning("checkpoint at v%d failed: %s", v, e)
         if (
             self._master_client is not None
             and self._evaluation_steps

@@ -165,10 +165,15 @@ class CheckpointSaver:
         for n, shards in by_layout.items():
             if shards != set(range(n)):
                 continue
-            mtime = max(
-                os.path.getmtime(_shard_file(self._dir, version, i, n))
-                for i in range(n)
-            )
+            try:
+                mtime = max(
+                    os.path.getmtime(_shard_file(self._dir, version, i, n))
+                    for i in range(n)
+                )
+            except OSError:
+                # A sibling shard's GC removed files between listdir and
+                # stat — the layout is no longer complete, skip it.
+                continue
             if best is None or mtime > best_mtime:
                 best, best_mtime = n, mtime
         return best

@@ -318,10 +318,15 @@ class CollectiveTrainer(Trainer):
         return named
 
     def save_checkpoint(self):
+        """Params AND optimizer state (``opt/``-prefixed, mirroring
+        spmd_trainer) — an elastic restore must resume the Adam/momentum
+        trajectory, not restart it (reference PS slot persistence,
+        go/pkg/ps/checkpoint.go:98-133)."""
         with self.timing.timeit("checkpoint_save"):
-            self._checkpoint_saver.save(
-                self._version, dense=self.export_parameters()
-            )
+            payload = dict(self.export_parameters())
+            opt_named, _ = flatten_with_names(to_numpy(self._opt_state))
+            payload.update({"opt/" + k: v for k, v in opt_named.items()})
+            self._checkpoint_saver.save(self._version, dense=payload)
         logger.info("saved checkpoint at version %d", self._version)
 
     def init_from_checkpoint(self):
@@ -333,8 +338,32 @@ class CollectiveTrainer(Trainer):
             return False
         from elasticdl_tpu.utils.pytree import unflatten_from_names
 
-        self._params = unflatten_from_names(to_numpy(self._params), dense)
-        self._opt_state = self._spec.optimizer.init(self._params)
+        params_named = {
+            k: v for k, v in dense.items() if not k.startswith("opt/")
+        }
+        opt_named = {
+            k[len("opt/"):]: v for k, v in dense.items()
+            if k.startswith("opt/")
+        }
+        self._params = unflatten_from_names(
+            to_numpy(self._params), params_named
+        )
+        fresh_opt = True
+        if opt_named:
+            try:
+                self._opt_state = unflatten_from_names(
+                    to_numpy(self._opt_state), opt_named
+                )
+                fresh_opt = False
+            except (KeyError, ValueError) as e:
+                # Optimizer changed since the checkpoint (e.g. Adam ->
+                # momentum): params are still good, trajectory is not.
+                logger.warning(
+                    "checkpoint optimizer state incompatible (%s); "
+                    "re-initializing optimizer", e,
+                )
+        if fresh_opt:  # pre-opt-state checkpoint or structure mismatch
+            self._opt_state = self._spec.optimizer.init(self._params)
         if self._mesh is not None:
             self.rebuild(self._mesh)
         self._version = version

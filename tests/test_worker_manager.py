@@ -1,0 +1,232 @@
+"""Elasticity manager under test (VERDICT r1 #3).
+
+Unit-tests the worker state-flow table and WorkerManager's relaunch
+decisions against a fake backend, then drills the real thing: a managed
+job with process workers where one is SIGKILLed mid-run (reference
+semantics: pod_state.py:28-106, master_test.py:51-107).
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.master import worker_state as ws
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.master.worker_manager import (
+    ProcessWorkerBackend,
+    WorkerManager,
+)
+
+
+# -- state-flow table ---------------------------------------------------------
+
+def test_flow_preempted_relaunches():
+    flow = ws.get_flow(ws.RUNNING, ws.EV_PREEMPTED)
+    assert flow.to_status == ws.DELETED and flow.should_relaunch
+
+
+def test_flow_oom_never_relaunches():
+    """Exit-137 analog: an OOM-killed worker would just OOM again
+    (reference pod_manager.py:102-115)."""
+    flow = ws.get_flow(ws.RUNNING, ws.EV_OOM)
+    assert flow.to_status == ws.FAILED and not flow.should_relaunch
+
+
+def test_flow_clean_exit_no_relaunch():
+    flow = ws.get_flow(ws.RUNNING, ws.EV_EXIT_0)
+    assert flow.to_status == ws.SUCCEEDED and not flow.should_relaunch
+
+
+def test_flow_error_exit_relaunches_from_pending_and_running():
+    for status in (ws.PENDING, ws.RUNNING):
+        flow = ws.get_flow(status, ws.EV_EXIT_ERR)
+        assert flow.to_status == ws.FAILED and flow.should_relaunch
+
+
+def test_flow_unknown_transition_is_none():
+    assert ws.get_flow(ws.SUCCEEDED, ws.EV_EXIT_ERR) is None
+
+
+# -- WorkerManager against a fake backend ------------------------------------
+
+class FakeRef:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+        self._exit = threading.Event()
+        self.code = None
+
+    def finish(self, code):
+        self.code = code
+        self._exit.set()
+
+
+class FakeBackend:
+    def __init__(self):
+        self.refs = {}
+
+    def launch(self, worker_id, master_addr):
+        ref = FakeRef(worker_id)
+        self.refs[worker_id] = ref
+        return ref
+
+    def wait(self, ref):
+        ref._exit.wait()
+        return ref.code
+
+    def kill(self, ref, force=False):
+        ref.finish(-signal.SIGKILL if force else -signal.SIGTERM)
+
+    def is_alive(self, ref):
+        return not ref._exit.is_set()
+
+
+def wait_until(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def make_manager(num_workers=2, **kwargs):
+    backend = FakeBackend()
+    mgr = WorkerManager(backend, num_workers=num_workers, **kwargs)
+    mgr.set_master_addr("localhost:0")
+    mgr.start()
+    return backend, mgr
+
+
+def test_crash_relaunches_with_fresh_id():
+    backend, mgr = make_manager(2)
+    backend.refs[0].finish(1)  # worker 0 crashes
+    assert wait_until(lambda: 2 in backend.refs)
+    assert mgr._workers[2].relaunch_count == 1
+    assert sorted(backend.refs) == [0, 1, 2]  # ids never reused
+    mgr.stop()
+
+
+def test_relaunch_budget_exhausts():
+    backend, mgr = make_manager(1, max_relaunch_count=2)
+    for wid in (0, 1, 2):
+        assert wait_until(lambda: wid in backend.refs)
+        backend.refs[wid].finish(1)
+        # allow the watcher to process the exit
+        assert wait_until(
+            lambda: not backend.is_alive(backend.refs[wid])
+        )
+    # budget spent after 2 relaunches: no worker 3, job is stalled
+    assert wait_until(lambda: mgr.all_workers_done())
+    assert 3 not in backend.refs
+    mgr.stop()
+
+
+def test_oom_killed_worker_not_relaunched():
+    backend, mgr = make_manager(1)
+    backend.refs[0].finish(137)  # container OOM exit code
+    assert wait_until(lambda: mgr.all_workers_done())
+    assert list(backend.refs) == [0]
+    mgr.stop()
+
+
+def test_preempt_drill_is_not_done_window():
+    """Between the SIGKILL and the relaunch, all_workers_done must stay
+    False (relaunch_pending masks the dead-but-recovering window), or the
+    master would abort a healthy job."""
+    backend, mgr = make_manager(1)
+    seen_done = []
+    orig_kill = backend.kill
+
+    def kill_and_probe(ref, force=False):
+        orig_kill(ref, force=force)
+        seen_done.append(mgr.all_workers_done())
+
+    backend.kill = kill_and_probe
+    mgr.preempt_worker(0)
+    assert wait_until(lambda: 1 in backend.refs)
+    assert seen_done == [False]
+    mgr.stop()
+
+
+def test_exit_callbacks_fire_with_relaunch_decision():
+    backend, mgr = make_manager(1)
+    events = []
+    mgr.add_exit_callback(lambda wid, rl: events.append((wid, rl)))
+    backend.refs[0].finish(1)
+    assert wait_until(lambda: 1 in backend.refs)
+    backend.refs[1].finish(0)
+    assert wait_until(lambda: len(events) == 2)
+    assert events == [(0, True), (1, False)]
+    mgr.stop()
+
+
+# -- end-to-end drills with real processes -----------------------------------
+
+def _managed_job(records, num_workers, worker_args_extra=(), num_epochs=1):
+    from elasticdl_tpu.data.factory import create_data_reader
+
+    reader = create_data_reader(
+        "synthetic_mnist:%d" % records, records_per_shard=128
+    )
+    task_manager = TaskManager(
+        training_shards=reader.create_shards(), records_per_task=128,
+        num_epochs=num_epochs,
+    )
+    worker_args = [
+        "--model_zoo", "mnist",
+        "--data_origin", "synthetic_mnist:%d" % records,
+        "--batch_size", "32", "--num_minibatches_per_task", "4",
+        "--num_epochs", str(num_epochs),
+    ] + list(worker_args_extra)
+    worker_manager = WorkerManager(
+        ProcessWorkerBackend(worker_args=worker_args),
+        num_workers=num_workers,
+    )
+    return Master(task_manager, worker_manager=worker_manager)
+
+
+@pytest.mark.slow
+def test_sigkill_mid_job_recovers_and_completes():
+    """The headline drill, in-suite: SIGKILL a real worker process
+    mid-job; the job must relaunch it under a fresh id and finish with
+    zero permanently-failed tasks."""
+    master = _managed_job(records=2048, num_workers=2, num_epochs=2)
+    launched = []
+    master.worker_manager.add_start_callback(launched.append)
+    master.prepare()
+
+    def preempt():
+        # wait for a worker to be mid-training, then kill it
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            counts = master.task_manager.counts()
+            if counts["completed"].get(0, 0) >= 1:
+                break
+            time.sleep(0.1)
+        master.worker_manager.preempt_worker(0, force=True)
+
+    killer = threading.Thread(target=preempt)
+    killer.start()
+    rc = master.run()
+    killer.join()
+    counts = master.task_manager.counts()
+    assert rc == 0
+    assert counts["todo"] == 0 and counts["doing"] == 0
+    assert all(v == 0 for v in counts["failed"].values())
+    assert 2 in launched  # replacement got a fresh id, not a reused one
+
+
+@pytest.mark.slow
+def test_all_workers_crashing_aborts_job():
+    """Workers that can never start (bad zoo module) exhaust the
+    relaunch budget; master.run() must return 1, not hang (the
+    all_workers_done stall-abort, master.py:85-98)."""
+    master = _managed_job(records=256, num_workers=1)
+    master.worker_manager._backend._worker_args[1] = "no_such_model"
+    master.prepare()
+    rc = master.run()
+    assert rc == 1
