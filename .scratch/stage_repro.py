@@ -1,0 +1,43 @@
+import sys; sys.path.insert(0, "/root/repo")
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["ELASTICDL_TPU_PLATFORM"] = "cpu"
+import subprocess, time
+from elasticdl_tpu.utils import grpc_utils
+print("A: imports ok", flush=True)
+ports = [grpc_utils.find_free_port() for _ in range(2)]
+procs = []
+for i, port in enumerate(ports):
+    env = dict(os.environ)
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "elasticdl_tpu.ps.server",
+         "--port", str(port), "--ps_id", str(i), "--num_ps", "2",
+         "--opt_type", "adam", "--opt_args", "learning_rate=0.001"],
+        env=env))
+print("B: ps spawned", flush=True)
+chans = []
+for port in ports:
+    ch = grpc_utils.build_channel("localhost:%d" % port)
+    grpc_utils.wait_for_channel_ready(ch, timeout=30)
+    chans.append(ch)
+print("C: channels ready", flush=True)
+from elasticdl_tpu.worker.ps_client import PSClient
+from elasticdl_tpu.models import deepfm
+from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+client = PSClient(chans)
+spec = deepfm.model_spec(num_fields=10, vocab_size=100000, embedding_dim=8)
+print("D: spec ok", flush=True)
+trainer = ParameterServerTrainer(spec, client, batch_size=512, get_model_steps=1)
+print("E: trainer init ok", flush=True)
+dense, ids, labels = deepfm.synthetic_data(n=1024, num_fields=10, vocab_size=100000, seed=0)
+records = [(dense[j], ids[j], labels[j]) for j in range(512)]
+batch = spec.feed(records)
+t0 = time.time()
+loss, v = trainer.train_minibatch(*batch)
+print("F: first step ok", round(time.time()-t0,1), float(loss), flush=True)
+t0 = time.time(); n = 20
+for k in range(n):
+    loss, v = trainer.train_minibatch(*batch)
+print("G: %.1f steps/s" % (n/(time.time()-t0)), flush=True)
+for p in procs: p.terminate()
+print("H: done", flush=True)

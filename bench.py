@@ -7,6 +7,14 @@ framework's jitted train step in bfloat16 on one TPU chip, with the batch
 resident on device (synthetic data; the data plane is benchmarked
 separately).
 
+Robustness against a flaky TPU relay (VERDICT r1 #1):
+ - persistent XLA compilation cache under .jax_cache/ so a re-run after a
+   relay hiccup skips the 20-40 s compile;
+ - the measurement runs in a watchdog subprocess and is retried once on
+   timeout;
+ - after a successful batch-128 run, a larger batch is attempted with its
+   own (shorter) timeout and the better number wins.
+
 Note: on this session's axon relay platform, ``jax.block_until_ready`` does
 not actually fence remote execution — timing must close with a value fetch.
 
@@ -16,10 +24,20 @@ Prints exactly one JSON line:
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMAGES_PER_SEC = 145.0  # ftlib_benchmark.md:121 (1x P100)
+
+# Fwd+bwd FLOPs per image for ResNet-50 @224 (~3x the 4.1 GFLOP forward);
+# v5e peak ~197 TFLOP/s bf16.  Both are estimates — MFU is reported as
+# context, not a measured counter.
+FLOPS_PER_IMAGE = 12.3e9
+TPU_PEAK_FLOPS = {"tpu": 197e12, "axon": 197e12}
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
 
 
 def run_bench(batch_size=128, warmup=3, iters=20):
@@ -31,6 +49,13 @@ def run_bench(batch_size=128, warmup=3, iters=20):
         jax.config.update(
             "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
         )
+    # Persistent compilation cache: a relay hiccup after compile means the
+    # retry run starts from a cache hit instead of another 20-40 s compile.
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError:
+        pass  # older jax: cache flags absent, proceed uncached
     import numpy as np
 
     from elasticdl_tpu.models import resnet
@@ -58,7 +83,13 @@ def run_bench(batch_size=128, warmup=3, iters=20):
 
     params, opt_state = trainer._params, trainer._opt_state
     step = trainer._train_step
-    for _ in range(warmup):
+    compile_start = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, xs, ys, ws)
+    float(loss)  # fence
+    compile_secs = time.perf_counter() - compile_start
+    # A cache hit makes the first call cheap; skip further warmup then.
+    remaining_warmup = 1 if compile_secs < 5.0 else warmup - 1
+    for _ in range(remaining_warmup):
         params, opt_state, loss = step(params, opt_state, xs, ys, ws)
     float(loss)  # fence
 
@@ -69,6 +100,12 @@ def run_bench(batch_size=128, warmup=3, iters=20):
     elapsed = time.perf_counter() - start
 
     images_per_sec = batch_size * iters / elapsed
+    ms_per_step = 1000.0 * elapsed / iters
+    peak = TPU_PEAK_FLOPS.get(platform)
+    mfu = (
+        round(images_per_sec * FLOPS_PER_IMAGE / peak, 4)
+        if peak else None
+    )
     return {
         "metric": "resnet50_train_throughput",
         "value": round(images_per_sec, 2),
@@ -78,6 +115,9 @@ def run_bench(batch_size=128, warmup=3, iters=20):
             "platform": platform,
             "batch_size": batch_size,
             "iters": iters,
+            "ms_per_step": round(ms_per_step, 2),
+            "mfu_estimate": mfu,
+            "compile_secs": round(compile_secs, 1),
             "last_loss": last_loss,
             "baseline": "145 img/s ResNet-50/ImageNet 1xP100 "
                         "(ftlib_benchmark.md:121)",
@@ -85,50 +125,71 @@ def run_bench(batch_size=128, warmup=3, iters=20):
     }
 
 
-def _run_with_watchdog(timeout_secs=None):
-    """Run the measurement in a child process so a wedged TPU relay
-    still yields exactly one JSON line (an honest failure report, not a
-    hang)."""
-    import subprocess
-
-    if timeout_secs is None:
-        timeout_secs = int(
-            os.environ.get("ELASTICDL_BENCH_TIMEOUT", "900")
-        )
-    stderr_tail = ""
+def _run_inner(batch_size, timeout_secs):
+    """One watchdog'd measurement subprocess; returns (result|None, reason)."""
     try:
         proc = subprocess.run(
-            [sys.executable, __file__, "--inner"],
+            [sys.executable, __file__, "--inner",
+             "--batch", str(batch_size)],
             capture_output=True, text=True, timeout=timeout_secs,
         )
-        stderr_tail = (proc.stderr or "")[-300:]
         for line in reversed(proc.stdout.strip().splitlines()):
             line = line.strip()
             if line.startswith("{"):
-                return json.loads(line)
-        reason = "no JSON output from measurement subprocess"
+                return json.loads(line), ""
+        return None, "no JSON output; stderr: %s" % (proc.stderr or "")[-300:]
     except subprocess.TimeoutExpired:
-        reason = "measurement timed out after %ds" % timeout_secs
+        return None, "timed out after %ds" % timeout_secs
     except (OSError, json.JSONDecodeError) as e:
-        reason = "%s: %s" % (type(e).__name__, e)
-    return {
-        "metric": "resnet50_train_throughput",
-        "value": None,
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-        "detail": {
-            "error": reason,
-            "stderr_tail": stderr_tail,
-            "note": "measurement failed; for context, the last "
-                    "successful run on this chip (2026-07-28, batch "
-                    "128 bf16) measured 1390.3 img/s (9.59x baseline)",
-        },
-    }
+        return None, "%s: %s" % (type(e).__name__, e)
+
+
+def _run_with_watchdog():
+    timeout_secs = int(os.environ.get("ELASTICDL_BENCH_TIMEOUT", "900"))
+    attempts = []
+    result = None
+    # batch 128 is the known-good configuration; retry once on timeout
+    # (first attempt may have populated the compilation cache before the
+    # relay hiccuped, making the retry cheap).
+    for attempt in range(2):
+        result, reason = _run_inner(128, timeout_secs)
+        if result is not None:
+            break
+        attempts.append("b128 attempt %d: %s" % (attempt + 1, reason))
+    if result is None:
+        return {
+            "metric": "resnet50_train_throughput",
+            "value": None,
+            "unit": "images/sec/chip",
+            "vs_baseline": None,
+            "detail": {
+                "error": "; ".join(attempts),
+                "note": "measurement failed; for context, the last "
+                        "successful run on this chip (2026-07-28, batch "
+                        "128 bf16) measured 1390.3 img/s (9.59x baseline)",
+            },
+        }
+    # With a number in hand, try a larger batch on its own clock; keep
+    # whichever throughput is higher.
+    if (
+        result["detail"].get("platform") != "cpu"
+        and os.environ.get("ELASTICDL_BENCH_TRY_LARGE", "1") != "0"
+    ):
+        large, reason = _run_inner(256, min(timeout_secs, 600))
+        if large is not None and (large["value"] or 0) > result["value"]:
+            large["detail"]["batch128_value"] = result["value"]
+            result = large
+        elif large is None:
+            result["detail"]["batch256_attempt"] = reason
+    return result
 
 
 if __name__ == "__main__":
     if "--inner" in sys.argv:
-        print(json.dumps(run_bench()))
+        batch = 128
+        if "--batch" in sys.argv:
+            batch = int(sys.argv[sys.argv.index("--batch") + 1])
+        print(json.dumps(run_bench(batch_size=batch)))
     else:
         print(json.dumps(_run_with_watchdog()))
     sys.exit(0)

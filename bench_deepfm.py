@@ -1,0 +1,162 @@
+"""DeepFM steps/sec over the full parameter-server path.
+
+The sparse-CTR benchmark named in BASELINE.json (the reference's async-PS
+benchmark role, docs/benchmark/report_cn.md): a worker trains DeepFM
+through 2 real PS shard subprocesses — gRPC push/pull, tensor codec,
+id-mod-N sharding, native C++ optimizer kernels — end to end.  Each
+"step" is one minibatch: pull dense params, pull unique embedding rows,
+jitted fwd/bwd, push dense+sparse gradients.
+
+The reference publishes no absolute DeepFM steps/sec (report_cn is a
+scaling study), so ``vs_baseline`` is null; the absolute number and its
+breakdown are the artifact.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# The PS path is host-side (numpy + C++ kernels + gRPC) and the worker's
+# jitted step is tiny, so this bench runs on CPU and never depends on the
+# TPU relay.  Force it: the session shell exports JAX_PLATFORMS=axon, so
+# a setdefault would silently aim the worker at the relay (and hang when
+# the relay is wedged).  Override with ELASTICDL_TPU_PLATFORM to test
+# another platform deliberately.
+_PLATFORM = os.environ.get("ELASTICDL_TPU_PLATFORM") or "cpu"
+os.environ["ELASTICDL_TPU_PLATFORM"] = _PLATFORM
+os.environ["JAX_PLATFORMS"] = _PLATFORM
+
+
+def run_bench(num_ps=2, batch_size=512, vocab_size=100_000,
+              num_fields=10, embedding_dim=8, warmup=5, iters=50):
+    import jax
+
+    if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+        jax.config.update(
+            "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+        )
+    import numpy as np
+
+    from elasticdl_tpu.models import deepfm
+    from elasticdl_tpu.utils import grpc_utils
+    from elasticdl_tpu.worker.ps_client import PSClient
+    from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+    ports = [grpc_utils.find_free_port() for _ in range(num_ps)]
+    procs = []
+    try:
+        for i, port in enumerate(ports):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"  # PS is host-side numpy/C++
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "elasticdl_tpu.ps.server",
+                 "--port", str(port), "--ps_id", str(i),
+                 "--num_ps", str(num_ps),
+                 "--opt_type", "adam", "--opt_args",
+                 "learning_rate=0.001"],
+                env=env,
+            ))
+        channels = []
+        for port in ports:
+            ch = grpc_utils.build_channel("localhost:%d" % port)
+            grpc_utils.wait_for_channel_ready(ch, timeout=30)
+            channels.append(ch)
+        client = PSClient(channels)
+
+        spec = deepfm.model_spec(
+            num_fields=num_fields, vocab_size=vocab_size,
+            embedding_dim=embedding_dim,
+        )
+        trainer = ParameterServerTrainer(
+            spec, client, batch_size=batch_size, get_model_steps=1
+        )
+        dense, ids, labels = deepfm.synthetic_data(
+            n=batch_size * 8, num_fields=num_fields,
+            vocab_size=vocab_size, seed=0,
+        )
+        batches = []
+        for s in range(0, len(labels), batch_size):
+            records = [
+                (dense[j], ids[j], labels[j])
+                for j in range(s, s + batch_size)
+            ]
+            batches.append(spec.feed(records))
+
+        for k in range(warmup):
+            trainer.train_minibatch(*batches[k % len(batches)])
+        start = time.perf_counter()
+        for k in range(iters):
+            loss, version = trainer.train_minibatch(
+                *batches[k % len(batches)]
+            )
+        elapsed = time.perf_counter() - start
+
+        steps_per_sec = iters / elapsed
+        platform = jax.devices()[0].platform
+        return {
+            "metric": "deepfm_ps_steps_per_sec",
+            "value": round(steps_per_sec, 2),
+            "unit": "steps/sec",
+            "vs_baseline": None,
+            "detail": {
+                "platform": platform,
+                "num_ps": num_ps,
+                "batch_size": batch_size,
+                "vocab_size": vocab_size,
+                "num_fields": num_fields,
+                "embedding_dim": embedding_dim,
+                "examples_per_sec": round(steps_per_sec * batch_size, 1),
+                "ms_per_step": round(1000.0 * elapsed / iters, 2),
+                "last_loss": float(loss),
+                "ps_version": int(version),
+                "baseline": "reference publishes no absolute DeepFM "
+                            "steps/sec (report_cn.md is a scaling "
+                            "study)",
+            },
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def _run_with_watchdog(timeout_secs=None):
+    if timeout_secs is None:
+        timeout_secs = int(
+            os.environ.get("ELASTICDL_BENCH_TIMEOUT", "600")
+        )
+    stderr_tail = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--inner"],
+            capture_output=True, text=True, timeout=timeout_secs,
+        )
+        stderr_tail = (proc.stderr or "")[-300:]
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        reason = "no JSON output from measurement subprocess"
+    except subprocess.TimeoutExpired:
+        reason = "measurement timed out after %ds" % timeout_secs
+    except (OSError, json.JSONDecodeError) as e:
+        reason = "%s: %s" % (type(e).__name__, e)
+    return {
+        "metric": "deepfm_ps_steps_per_sec",
+        "value": None,
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "detail": {"error": reason, "stderr_tail": stderr_tail},
+    }
+
+
+if __name__ == "__main__":
+    if "--inner" in sys.argv:
+        print(json.dumps(run_bench()))
+    else:
+        print(json.dumps(_run_with_watchdog()))
+    sys.exit(0)

@@ -18,8 +18,12 @@ import sys
 import threading
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("ELASTICDL_TPU_PLATFORM", "cpu")
+# Force CPU (not setdefault: the session shell exports
+# JAX_PLATFORMS=axon, which would aim the drill workers at the TPU relay
+# and hang the control-plane measurement when the relay is wedged).
+_PLATFORM = os.environ.get("ELASTICDL_TPU_PLATFORM") or "cpu"
+os.environ["ELASTICDL_TPU_PLATFORM"] = _PLATFORM
+os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 
 def run_drill(num_workers=2, records=4096):

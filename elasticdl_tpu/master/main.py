@@ -25,6 +25,7 @@ logger = get_logger(__name__)
 _MASTER_ONLY_ARGS = (
     "port", "num_workers", "num_ps", "shuffle", "shuffle_shards",
     "max_task_retries", "task_timeout_secs", "relaunch_on_worker_failure",
+    "grads_to_wait", "sync_version_tolerance",
 )
 
 
@@ -115,6 +116,9 @@ def build_master(args):
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_steps=args.checkpoint_steps,
             evaluation_steps=args.evaluation_steps,
+            use_async=args.use_async,
+            grads_to_wait=args.grads_to_wait,
+            sync_version_tolerance=args.sync_version_tolerance,
         )
     worker_manager = None
     if args.num_workers > 0:

@@ -21,7 +21,7 @@ class PSManager:
     def __init__(self, num_ps, opt_type, opt_args, master_addr="",
                  checkpoint_dir="", checkpoint_steps=0,
                  evaluation_steps=0, use_async=True, grads_to_wait=1,
-                 max_relaunch=5):
+                 sync_version_tolerance=0, max_relaunch=5):
         self.num_ps = num_ps
         self._opt_type = opt_type
         self._opt_args = opt_args
@@ -31,6 +31,7 @@ class PSManager:
         self._evaluation_steps = evaluation_steps
         self._use_async = use_async
         self._grads_to_wait = grads_to_wait
+        self._sync_version_tolerance = sync_version_tolerance
         self._max_relaunch = max_relaunch
         self.ports = [find_free_port() for _ in range(num_ps)]
         self._procs = {}
@@ -51,6 +52,7 @@ class PSManager:
             "--opt_args", self._opt_args,
             "--use_async", str(self._use_async),
             "--grads_to_wait", str(self._grads_to_wait),
+            "--sync_version_tolerance", str(self._sync_version_tolerance),
             "--evaluation_steps", str(self._evaluation_steps),
         ]
         if self._master_addr:

@@ -33,6 +33,14 @@ SERVICES = {
         ),
         "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.TensorPB),
         "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+        "prepare_gradients": (
+            pb.PrepareGradientsRequest,
+            pb.PushGradientsResponse,
+        ),
+        "commit_gradients": (
+            pb.CommitGradientsRequest,
+            pb.PushGradientsResponse,
+        ),
     },
 }
 

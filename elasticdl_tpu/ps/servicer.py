@@ -13,6 +13,7 @@ go/pkg/ps/server.go:54-253:
 """
 
 import threading
+import time
 
 import numpy as np
 
@@ -53,6 +54,8 @@ class PserverServicer:
         self._master_client = master_client
         self._lock = threading.Lock()
         self._grad_buffer = []   # [(dense, embeddings)] awaiting sync apply
+        self._staged = {}        # txn_id -> (dense, emb, lr, stage_time)
+        self._staged_ttl = 60.0  # abandon prepares from dead workers
 
     # -- RPCs ---------------------------------------------------------------
 
@@ -131,6 +134,66 @@ class PserverServicer:
             version = self._params.version
             self._post_update()
             return pb.PushGradientsResponse(accepted=True, version=version)
+
+    def prepare_gradients(self, request, _context=None):
+        """Phase 1 of the cross-shard atomic sync push: run the staleness
+        check and stage the gradients.  Nothing is applied until commit,
+        so a reject on any sibling shard can abort everywhere — no shard
+        ever half-applies a minibatch (reference semantics were per-shard,
+        python/ps/servicer.py:168-238; this closes that gap)."""
+        dense, embeddings, _, grad_version = tensor_codec.pb_to_model(
+            request.gradients
+        )
+        with self._lock:
+            now = time.monotonic()
+            for txn in [
+                t for t, (_, _, _, ts) in self._staged.items()
+                if now - ts > self._staged_ttl
+            ]:
+                del self._staged[txn]  # worker died between phases
+            if not self._use_async and grad_version < (
+                self._params.version - self._sync_version_tolerance
+            ):
+                return pb.PushGradientsResponse(
+                    accepted=False, version=self._params.version
+                )
+            self._staged[request.txn_id] = (
+                dense, embeddings, request.learning_rate or None, now
+            )
+            return pb.PushGradientsResponse(
+                accepted=True, version=self._params.version
+            )
+
+    def commit_gradients(self, request, _context=None):
+        """Phase 2: fold the staged entry into the sync buffer (or apply
+        immediately in async mode), or drop it on abort.  Commit is
+        unconditional — staleness was settled at prepare, so the
+        effective tolerance is ``sync_version_tolerance`` plus in-flight
+        commit concurrency (bounded by the worker count)."""
+        with self._lock:
+            staged = self._staged.pop(request.txn_id, None)
+            if not request.commit or staged is None:
+                return pb.PushGradientsResponse(
+                    accepted=False, version=self._params.version
+                )
+            dense, embeddings, lr_override, _ = staged
+            if self._use_async:
+                self._apply(dense, embeddings, 1.0, lr_override)
+                self._params.version += 1
+                self._post_update()
+                return pb.PushGradientsResponse(
+                    accepted=True, version=self._params.version
+                )
+            self._grad_buffer.append((dense, embeddings))
+            if len(self._grad_buffer) >= self._grads_to_wait:
+                dense_sum, emb_cat = self._reduce_buffer()
+                self._grad_buffer.clear()
+                self._apply(dense_sum, emb_cat, 1.0, lr_override)
+                self._params.version += 1
+                self._post_update()
+            return pb.PushGradientsResponse(
+                accepted=True, version=self._params.version
+            )
 
     # -- internals ----------------------------------------------------------
 

@@ -54,6 +54,9 @@ def build_master_parser():
     parser.add_argument("--num_workers", type=int, default=0,
                         help="0 = workers launched externally")
     parser.add_argument("--num_ps", type=int, default=0)
+    parser.add_argument("--use_async", type=_str2bool, default=True)
+    parser.add_argument("--grads_to_wait", type=int, default=1)
+    parser.add_argument("--sync_version_tolerance", type=int, default=0)
     parser.add_argument("--shuffle", type=_str2bool, default=False)
     parser.add_argument("--shuffle_shards", type=_str2bool, default=False)
     parser.add_argument("--max_task_retries", type=int, default=3)
@@ -69,6 +72,9 @@ def build_worker_parser():
     parser.add_argument("--worker_id", type=int, default=-1)
     parser.add_argument("--ps_addrs", default="",
                         help="comma-separated parameter server addresses")
+    parser.add_argument("--use_async", type=_str2bool, default=True,
+                        help="PS mode; sync (False) selects the atomic "
+                             "prepare/commit gradient push")
     return parser
 
 

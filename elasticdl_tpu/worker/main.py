@@ -76,6 +76,7 @@ def build_worker(args):
             batch_size=args.batch_size,
             master_client=mc,
             rng_seed=args.seed,
+            atomic_sync=not args.use_async,
         )
         return Worker(
             mc, reader, spec, trainer,

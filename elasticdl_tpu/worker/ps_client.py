@@ -6,6 +6,8 @@ out to all shards as concurrent gRPC futures; duplicate embedding ids are
 merged before pushing.
 """
 
+import uuid
+
 import numpy as np
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
@@ -112,26 +114,14 @@ class PSClient:
         {table: (values [n, dim], ids [n])}.  Returns (accepted,
         max_server_version).
 
-        Known limitation (shared with the reference's per-shard sync
-        buffering): in sync mode with num_ps > 1 the fan-out is not
-        atomic — if one shard rejects a stale push while another accepts,
-        the retried minibatch is applied again on the accepting shard.
-        ``sync_version_tolerance`` already admits bounded staleness, and
-        the double-apply is within that bound, but jobs wanting strict
-        once-per-minibatch application should run one PS shard or async
-        mode."""
-        embedding_grads = embedding_grads or {}
-        shard_dense = [dict() for _ in range(self.num_ps)]
-        for name, g in dense_grads.items():
-            shard_dense[hashing.string_to_id(name, self.num_ps)][name] = g
-        shard_emb = [dict() for _ in range(self.num_ps)]
-        for table, (values, ids) in embedding_grads.items():
-            values, ids = tensor_codec.merge_indexed_slices(values, ids)
-            owners = np.asarray(ids) % self.num_ps
-            for shard in range(self.num_ps):
-                sel = owners == shard
-                if sel.any():
-                    shard_emb[shard][table] = (values[sel], ids[sel])
+        One-shot fan-out: each shard accepts/rejects independently, which
+        is fine in async mode (every push stands alone) but not atomic in
+        sync mode with num_ps > 1 — use :meth:`push_gradients_atomic` for
+        sync jobs so a stale reject on one shard aborts the minibatch on
+        every shard."""
+        shard_dense, shard_emb = self._shard_gradients(
+            dense_grads, embedding_grads
+        )
         futures = []
         for shard in range(self.num_ps):
             if not shard_dense[shard] and not shard_emb[shard]:
@@ -152,3 +142,71 @@ class PSClient:
             accepted = accepted and res.accepted
             max_version = max(max_version, res.version)
         return accepted, max_version
+
+    def _shard_gradients(self, dense_grads, embedding_grads):
+        """Route gradients to their owning shards: dense by name hash,
+        embedding rows by id mod N (duplicates merged first)."""
+        embedding_grads = embedding_grads or {}
+        shard_dense = [dict() for _ in range(self.num_ps)]
+        for name, g in dense_grads.items():
+            shard_dense[hashing.string_to_id(name, self.num_ps)][name] = g
+        shard_emb = [dict() for _ in range(self.num_ps)]
+        for table, (values, ids) in embedding_grads.items():
+            values, ids = tensor_codec.merge_indexed_slices(values, ids)
+            owners = np.asarray(ids) % self.num_ps
+            for shard in range(self.num_ps):
+                sel = owners == shard
+                if sel.any():
+                    shard_emb[shard][table] = (values[sel], ids[sel])
+        return shard_dense, shard_emb
+
+    def push_gradients_atomic(self, dense_grads, embedding_grads=None,
+                              version=0, learning_rate=0.0):
+        """Cross-shard atomic push (sync mode): prepare on every shard,
+        commit only on unanimous accept, abort everywhere otherwise.
+
+        Every shard gets a prepare — including shards that own no
+        gradient this minibatch — so sync buffers fill and version
+        counters advance in lockstep instead of drifting."""
+        txn_id = uuid.uuid4().hex
+        shard_dense, shard_emb = self._shard_gradients(
+            dense_grads, embedding_grads
+        )
+        prepare_futures = []
+        for shard in range(self.num_ps):
+            model = tensor_codec.model_to_pb(
+                dense=shard_dense[shard],
+                embeddings=shard_emb[shard],
+                version=version,
+            )
+            req = pb.PrepareGradientsRequest(
+                txn_id=txn_id, gradients=model,
+                learning_rate=learning_rate,
+            )
+            prepare_futures.append(
+                self._stubs[shard].prepare_gradients.future(req)
+            )
+        all_accept = True
+        max_version = 0
+        for f in prepare_futures:
+            res = f.result()
+            all_accept = all_accept and res.accepted
+            max_version = max(max_version, res.version)
+        commit_req = pb.CommitGradientsRequest(
+            txn_id=txn_id, commit=all_accept
+        )
+        commit_futures = [
+            stub.commit_gradients.future(commit_req)
+            for stub in self._stubs
+        ]
+        committed = True
+        for f in commit_futures:
+            res = f.result()
+            committed = committed and res.accepted
+            max_version = max(max_version, res.version)
+        # A commit that found no staged txn (TTL-evicted after a long
+        # stall) means a shard missed the minibatch: surface it as a
+        # failed push so the worker re-pulls and retries — bounded
+        # double-apply on the shards that did commit, never a silent
+        # half-apply.
+        return all_accept and committed, max_version

@@ -52,6 +52,7 @@ class ParameterServerTrainer(Trainer):
         get_model_steps=1,
         rng_seed=0,
         learning_rate=0.0,
+        atomic_sync=False,
     ):
         self._spec = spec
         self._ps = ps_client
@@ -59,6 +60,9 @@ class ParameterServerTrainer(Trainer):
         self._mc = master_client
         self._get_model_steps = get_model_steps
         self._learning_rate = learning_rate
+        # Sync jobs with num_ps > 1 need the prepare/commit push so one
+        # shard's stale-reject aborts the minibatch on every shard.
+        self._atomic_sync = atomic_sync
         self.timing = Timing(logger=logger)
 
         self._params = spec.init_fn(jax.random.PRNGKey(rng_seed))
@@ -217,7 +221,11 @@ class ParameterServerTrainer(Trainer):
             for table, (uniq_ids, n_uniq) in push_info.items():
                 rows = np.asarray(emb_grads[table])[:n_uniq]
                 emb_push[table] = (rows, uniq_ids)
-            accepted, version = self._ps.push_gradients(
+            push = (
+                self._ps.push_gradients_atomic if self._atomic_sync
+                else self._ps.push_gradients
+            )
+            accepted, version = push(
                 named_grads, emb_push,
                 version=self._version,
                 learning_rate=self._learning_rate,

@@ -15,11 +15,10 @@ def test_master_args_roundtrip_to_worker():
         "--num_epochs", "3", "--shuffle", "true",
         "--distribution_strategy", "ps", "--num_workers", "2",
     ])
+    from elasticdl_tpu.master.main import _MASTER_ONLY_ARGS
+
     flags = build_arguments_from_parsed_result(
-        args, filter_args=("num_workers", "port", "num_ps", "shuffle",
-                           "shuffle_shards", "max_task_retries",
-                           "task_timeout_secs",
-                           "relaunch_on_worker_failure"),
+        args, filter_args=_MASTER_ONLY_ARGS,
     )
     worker_args = parse_worker_args(flags)
     assert worker_args.model_zoo == "deepfm"
