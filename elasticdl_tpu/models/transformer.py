@@ -216,48 +216,103 @@ def _constrain(x, mesh, spec):
     return x
 
 
-def forward(params, tokens, cfg, mesh=None):
-    """tokens: [B, T] int32 -> logits [B, T, V]."""
+def _layer_body(x, w, cfg, mesh, positions):
+    """One transformer block; shared by the scanned stack (forward) and
+    the per-stage slice scan (forward_pipelined)."""
     compute_dtype = jnp.dtype(cfg.dtype)
-    B, T = tokens.shape
     act_spec = P("dp", "sp", None)
-
-    x = params["embed"].astype(compute_dtype)[tokens]
-    x = _constrain(x, mesh, act_spec)
-    positions = jnp.arange(T)
+    B, T = x.shape[0], x.shape[1]
     H, D = cfg.num_heads, cfg.head_dim
-
-    def layer(x, w):
-        h = _rmsnorm(x, w["ln1"].astype(compute_dtype))
-        q = (h @ w["wq"].astype(compute_dtype)).reshape(B, T, H, D)
-        k = (h @ w["wk"].astype(compute_dtype)).reshape(B, T, H, D)
-        v = (h @ w["wv"].astype(compute_dtype)).reshape(B, T, H, D)
-        q = _rope(q, positions)
-        k = _rope(k, positions)
-        attn = ring_attention(q, k, v, mesh, causal=True)
-        attn = attn.reshape(B, T, H * D)
+    h = _rmsnorm(x, w["ln1"].astype(compute_dtype))
+    q = (h @ w["wq"].astype(compute_dtype)).reshape(B, T, H, D)
+    k = (h @ w["wk"].astype(compute_dtype)).reshape(B, T, H, D)
+    v = (h @ w["wv"].astype(compute_dtype)).reshape(B, T, H, D)
+    q = _rope(q, positions)
+    k = _rope(k, positions)
+    attn = ring_attention(q, k, v, mesh, causal=True)
+    attn = attn.reshape(B, T, H * D)
+    x = x + _constrain(
+        attn @ w["wo"].astype(compute_dtype), mesh, act_spec
+    )
+    h = _rmsnorm(x, w["ln2"].astype(compute_dtype))
+    if cfg.moe_experts:
+        x = x + _constrain(_moe_ffn(h, w, cfg, mesh), mesh, act_spec)
+    else:
+        gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
+        up = h @ w["w_up"].astype(compute_dtype)
         x = x + _constrain(
-            attn @ w["wo"].astype(compute_dtype), mesh, act_spec
+            (gate * up) @ w["w_down"].astype(compute_dtype), mesh,
+            act_spec,
         )
-        h = _rmsnorm(x, w["ln2"].astype(compute_dtype))
-        if cfg.moe_experts:
-            x = x + _constrain(_moe_ffn(h, w, cfg, mesh), mesh, act_spec)
-        else:
-            gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
-            up = h @ w["w_up"].astype(compute_dtype)
-            x = x + _constrain(
-                (gate * up) @ w["w_down"].astype(compute_dtype), mesh,
-                act_spec,
-            )
-        return x, None
+    return x
 
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+
+def _head(params, x, cfg):
+    compute_dtype = jnp.dtype(cfg.dtype)
     x = _rmsnorm(x, params["ln_f"].astype(compute_dtype))
     head = (
         params["embed"].T if cfg.tied_embeddings else params["lm_head"]
     ).astype(compute_dtype)
-    logits = x @ head
-    return logits.astype(jnp.float32)
+    return (x @ head).astype(jnp.float32)
+
+
+def forward(params, tokens, cfg, mesh=None):
+    """tokens: [B, T] int32 -> logits [B, T, V]."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+    act_spec = P("dp", "sp", None)
+
+    x = params["embed"].astype(compute_dtype)[tokens]
+    x = _constrain(x, mesh, act_spec)
+    positions = jnp.arange(tokens.shape[1])
+
+    def layer(x, w):
+        return _layer_body(x, w, cfg, mesh, positions), None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return _head(params, x, cfg)
+
+
+def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
+                      remat=False):
+    """Microbatch-pipelined forward over the ``pp`` mesh axis.
+
+    The layer stack runs as a GPipe schedule (parallel/pipeline.py):
+    S = mesh.shape['pp'] stages compute concurrently on different
+    microbatches, activations hopping stages via ppermute.  Bubble
+    fraction is (S-1)/(M+S-1) — S=2, M=8 -> 11.1%.  Embedding lookup and
+    the LM head run replicated over pp outside the pipeline (their FLOPs
+    are small next to the stack).  Attention is per-shard local inside a
+    stage, so this path requires sp=1; dp/tp compose as auto axes.
+    """
+    from elasticdl_tpu.parallel.pipeline import (
+        merge_microbatches,
+        pipeline_apply,
+        split_microbatches,
+    )
+
+    if mesh.shape.get("sp", 1) != 1:
+        raise ValueError(
+            "forward_pipelined requires sp=1 (stage-local attention); "
+            "use ring attention (plain forward) for sequence parallelism"
+        )
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"].astype(compute_dtype)[tokens]
+    positions = jnp.arange(tokens.shape[1])
+
+    def stage_fn(w, x_mb):
+        def body(x, w1):
+            return _layer_body(x, w1, cfg, None, positions), None
+
+        x_mb, _ = jax.lax.scan(body, x_mb, w)
+        return x_mb
+
+    xm = split_microbatches(x, num_microbatches)
+    ym = pipeline_apply(
+        stage_fn, params["layers"], xm, mesh=mesh,
+        num_microbatches=num_microbatches, remat=remat,
+    )
+    x = merge_microbatches(ym)
+    return _head(params, x, cfg)
 
 
 def next_token_loss(logits, tokens):
@@ -274,11 +329,28 @@ def next_token_loss(logits, tokens):
 
 
 def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
-               seq_len=512, learning_rate=3e-4, mesh=None, dtype="bfloat16"):
+               seq_len=512, learning_rate=3e-4, mesh=None, dtype="bfloat16",
+               pipeline_microbatches=0):
     cfg = TransformerConfig(
         vocab_size=vocab_size, dim=dim, num_heads=num_heads,
         num_layers=num_layers, max_seq_len=seq_len, dtype=dtype,
     )
+    pipelined = (
+        pipeline_microbatches > 0
+        and mesh is not None
+        and mesh.shape.get("pp", 1) > 1
+        and mesh.shape.get("sp", 1) == 1
+    )
+    if pipeline_microbatches > 0 and not pipelined and mesh is not None:
+        # sp>1 keeps the scanned stage-sharded layout (ring attention
+        # needs the sequence axis); say so instead of failing per-step.
+        import warnings
+
+        warnings.warn(
+            "pipeline_microbatches ignored: pipelining requires pp>1 "
+            "and sp=1 on the mesh; using the scanned forward",
+            stacklevel=2,
+        )
 
     def init_fn(rng):
         params = init_params(rng, cfg)
@@ -287,6 +359,10 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
         return params
 
     def apply_fn(params, tokens, train):
+        if pipelined:
+            return forward_pipelined(
+                params, tokens, cfg, mesh, pipeline_microbatches
+            )
         return forward(params, tokens, cfg, mesh=mesh)
 
     def loss_fn(logits, tokens):
