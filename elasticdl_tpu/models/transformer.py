@@ -41,10 +41,14 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
     tied_embeddings: bool = True
-    # Mixture-of-experts: 0 = dense FFN; >0 = switch-style top-1 routing
-    # with experts sharded over the ``ep`` mesh axis.
+    # Mixture-of-experts: 0 = dense FFN; >0 = top-k routing with experts
+    # sharded over the ``ep`` mesh axis and a Switch-style auxiliary
+    # load-balance loss (weight ``moe_aux_weight``) to stop router
+    # collapse.
     moe_experts: int = 0
+    moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self):
@@ -169,30 +173,65 @@ def _rope(x, positions):
 
 
 def _moe_ffn(h, w, cfg, mesh):
-    """Switch-style top-1 MoE FFN (expert weights sharded over ``ep``).
+    """Top-k MoE FFN (expert weights sharded over ``ep``).
 
     Dense dispatch/combine einsum formulation (Mesh-TensorFlow style):
-    per-sequence expert capacity bounds compute; overflow tokens pass
-    through the residual only.  No aux load-balance loss yet — router
-    logits stay near-uniform at init which is adequate for the current
-    scale; the aux term is a planned addition.
+    per-sequence expert capacity bounds compute; overflow tokens fall to
+    lower-priority choices or the residual.  Returns (out, aux) where
+    aux is the Switch-Transformer load-balance loss
+    X * sum_x fraction_top1(x) * mean_prob(x) — 1.0 at perfect balance,
+    approaching X under router collapse — so minimizing it pushes the
+    router toward uniform utilization.
     """
     B, T, E = h.shape
     X = cfg.moe_experts
-    capacity = max(1, min(T, int(T * cfg.moe_capacity_factor / X) + 1))
+    K = min(cfg.moe_top_k, X)
+    # K choices per token -> expected per-expert load is K*T/X.
+    capacity = max(
+        1, min(T, int(T * K * cfg.moe_capacity_factor / X) + 1)
+    )
     logits = h @ w["w_router"].astype(h.dtype)            # [B,T,X]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                   # [B,T]
-    onehot = jax.nn.one_hot(expert, X, dtype=jnp.float32)
-    gate = (probs * onehot).sum(axis=-1)                  # [B,T]
-    # position of each token within its expert's capacity (per sequence)
-    pos = jnp.cumsum(onehot, axis=1) - 1.0                # [B,T,X]
-    keep = onehot * (pos < capacity)
-    disp = keep[..., None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity, dtype=jnp.float32
-    )                                                     # [B,T,X,C]
+
+    # Switch aux loss from the top-1 assignment (computed before
+    # capacity so it reflects router intent, not dispatch truncation).
+    top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), X,
+                          dtype=jnp.float32)
+    frac_tokens = top1.mean(axis=(0, 1))                  # [X]
+    mean_probs = probs.mean(axis=(0, 1))                  # [X]
+    aux = X * jnp.sum(frac_tokens * mean_probs)
+
+    gate_vals, experts = jax.lax.top_k(probs, K)          # [B,T,K]
+    if K > 1:
+        # GShard-style renormalization over the chosen experts.  Top-1
+        # keeps the raw p_top1 gate (Switch): renormalizing would make
+        # it identically 1.0 and cut the router out of the task loss.
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(axis=-1, keepdims=True), 1e-9
+        )
+
+    # Per-expert capacity slots: choice 0 has priority; choice j's
+    # positions start after all previous choices' tokens for that expert.
+    onehots = [
+        jax.nn.one_hot(experts[..., j], X, dtype=jnp.float32)
+        for j in range(K)
+    ]
+    disp = 0.0      # 0/1 dispatch  [B,T,X,C]
+    combine = 0.0   # gate-weighted combine  [B,T,X,C]
+    offset = jnp.zeros((B, 1, X), jnp.float32)
+    for j in range(K):
+        pos = jnp.cumsum(onehots[j], axis=1) - 1.0 + offset   # [B,T,X]
+        keep = onehots[j] * (pos < capacity)
+        slot = keep[..., None] * jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32),
+            capacity, dtype=jnp.float32,
+        )
+        disp = disp + slot
+        combine = combine + gate_vals[..., j, None, None] * slot
+        offset = offset + onehots[j].sum(axis=1, keepdims=True)
     if mesh is not None:
         disp = _constrain(disp, mesh, P("dp", "sp", "ep", None))
+        combine = _constrain(combine, mesh, P("dp", "sp", "ep", None))
     xin = jnp.einsum("btxc,bte->xbce", disp, h.astype(jnp.float32))
     xin = xin.astype(h.dtype)
     if mesh is not None:
@@ -203,9 +242,9 @@ def _moe_ffn(h, w, cfg, mesh):
     u = jnp.einsum("xbce,xef->xbcf", xin, w["w_up"].astype(h.dtype))
     y = jnp.einsum("xbcf,xfe->xbce", g * u,
                    w["w_down"].astype(h.dtype))
-    out = jnp.einsum("btxc,xbce->bte", disp,
+    out = jnp.einsum("btxc,xbce->bte", combine,
                      y.astype(jnp.float32))
-    return (out * gate[..., None]).astype(h.dtype)
+    return out.astype(h.dtype), aux
 
 
 def _constrain(x, mesh, spec):
@@ -216,7 +255,7 @@ def _constrain(x, mesh, spec):
     return x
 
 
-def _layer_body(x, w, cfg, mesh, positions):
+def _layer_body(x, w, cfg, mesh, positions, attention_mode=None):
     """One transformer block; shared by the scanned stack (forward) and
     the per-stage slice scan (forward_pipelined)."""
     compute_dtype = jnp.dtype(cfg.dtype)
@@ -229,14 +268,20 @@ def _layer_body(x, w, cfg, mesh, positions):
     v = (h @ w["wv"].astype(compute_dtype)).reshape(B, T, H, D)
     q = _rope(q, positions)
     k = _rope(k, positions)
-    attn = ring_attention(q, k, v, mesh, causal=True)
+    if mesh is None and attention_mode is not None:
+        from elasticdl_tpu.parallel.ring_attention import attention_local
+
+        attn = attention_local(q, k, v, causal=True, mode=attention_mode)
+    else:
+        attn = ring_attention(q, k, v, mesh, causal=True)
     attn = attn.reshape(B, T, H * D)
     x = x + _constrain(
         attn @ w["wo"].astype(compute_dtype), mesh, act_spec
     )
     h = _rmsnorm(x, w["ln2"].astype(compute_dtype))
     if cfg.moe_experts:
-        x = x + _constrain(_moe_ffn(h, w, cfg, mesh), mesh, act_spec)
+        moe_out, aux = _moe_ffn(h, w, cfg, mesh)
+        x = x + _constrain(moe_out, mesh, act_spec)
     else:
         gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
         up = h @ w["w_up"].astype(compute_dtype)
@@ -244,7 +289,8 @@ def _layer_body(x, w, cfg, mesh, positions):
             (gate * up) @ w["w_down"].astype(compute_dtype), mesh,
             act_spec,
         )
-    return x
+        aux = jnp.float32(0.0)
+    return x, aux
 
 
 def _head(params, x, cfg):
@@ -256,8 +302,12 @@ def _head(params, x, cfg):
     return (x @ head).astype(jnp.float32)
 
 
-def forward(params, tokens, cfg, mesh=None):
-    """tokens: [B, T] int32 -> logits [B, T, V]."""
+def forward(params, tokens, cfg, mesh=None, return_aux=False):
+    """tokens: [B, T] int32 -> logits [B, T, V].
+
+    With ``return_aux`` (training an MoE), also returns the mean
+    per-layer load-balance loss for the spec's loss_fn to add.
+    """
     compute_dtype = jnp.dtype(cfg.dtype)
     act_spec = P("dp", "sp", None)
 
@@ -266,10 +316,13 @@ def forward(params, tokens, cfg, mesh=None):
     positions = jnp.arange(tokens.shape[1])
 
     def layer(x, w):
-        return _layer_body(x, w, cfg, mesh, positions), None
+        return _layer_body(x, w, cfg, mesh, positions)
 
-    x, _ = jax.lax.scan(layer, x, params["layers"])
-    return _head(params, x, cfg)
+    x, aux_per_layer = jax.lax.scan(layer, x, params["layers"])
+    logits = _head(params, x, cfg)
+    if return_aux:
+        return logits, aux_per_layer.mean()
+    return logits
 
 
 def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
@@ -301,7 +354,15 @@ def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
 
     def stage_fn(w, x_mb):
         def body(x, w1):
-            return _layer_body(x, w1, cfg, None, positions), None
+            # attention_mode="off": inside the pp-manual shard_map the
+            # dp/tp axes are auto, and a pallas_call under auto axes
+            # would be all-gathered by GSPMD; the jnp path partitions.
+            # MoE aux losses are dropped on the pipelined path (the
+            # fill/drain ticks would pollute the statistic).
+            x, _aux = _layer_body(
+                x, w1, cfg, None, positions, attention_mode="off"
+            )
+            return x, None
 
         x_mb, _ = jax.lax.scan(body, x_mb, w)
         return x_mb
@@ -330,10 +391,13 @@ def next_token_loss(logits, tokens):
 
 def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
                seq_len=512, learning_rate=3e-4, mesh=None, dtype="bfloat16",
-               pipeline_microbatches=0):
+               pipeline_microbatches=0, moe_experts=0, moe_top_k=2,
+               moe_aux_weight=0.01):
     cfg = TransformerConfig(
         vocab_size=vocab_size, dim=dim, num_heads=num_heads,
         num_layers=num_layers, max_seq_len=seq_len, dtype=dtype,
+        moe_experts=moe_experts, moe_top_k=moe_top_k,
+        moe_aux_weight=moe_aux_weight,
     )
     pipelined = (
         pipeline_microbatches > 0
@@ -341,14 +405,23 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
         and mesh.shape.get("pp", 1) > 1
         and mesh.shape.get("sp", 1) == 1
     )
-    if pipeline_microbatches > 0 and not pipelined and mesh is not None:
-        # sp>1 keeps the scanned stage-sharded layout (ring attention
-        # needs the sequence axis); say so instead of failing per-step.
+    if pipeline_microbatches > 0 and not pipelined:
+        # No mesh, pp=1, or sp>1 (ring attention needs the sequence
+        # axis): say so instead of silently ignoring the knob.
         import warnings
 
         warnings.warn(
-            "pipeline_microbatches ignored: pipelining requires pp>1 "
-            "and sp=1 on the mesh; using the scanned forward",
+            "pipeline_microbatches ignored: pipelining requires a mesh "
+            "with pp>1 and sp=1; using the scanned forward",
+            stacklevel=2,
+        )
+    if pipelined and moe_experts:
+        import warnings
+
+        warnings.warn(
+            "pipelined MoE drops the aux load-balance loss (not "
+            "collected across pipeline stages yet); watch expert "
+            "utilization",
             stacklevel=2,
         )
 
@@ -363,10 +436,19 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
             return forward_pipelined(
                 params, tokens, cfg, mesh, pipeline_microbatches
             )
+        if cfg.moe_experts and train:
+            return forward(params, tokens, cfg, mesh=mesh,
+                           return_aux=True)
         return forward(params, tokens, cfg, mesh=mesh)
 
-    def loss_fn(logits, tokens):
-        return next_token_loss(logits, tokens)
+    def loss_fn(outputs, tokens):
+        if isinstance(outputs, tuple):  # MoE training: (logits, aux)
+            logits, aux = outputs
+            return (
+                next_token_loss(logits, tokens)
+                + cfg.moe_aux_weight * aux
+            )
+        return next_token_loss(outputs, tokens)
 
     def feed(records):
         toks = np.stack(

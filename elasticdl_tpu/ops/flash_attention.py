@@ -1,19 +1,35 @@
-"""Pallas flash attention (forward) for TPU.
+"""Pallas flash attention for TPU.
 
 The hot op of the long-context path.  One (batch*head, q-block) program
 holds its query tile in VMEM and streams K/V tiles of the same head
 through the MXU with the online-softmax accumulation, so the T x T score
-matrix never materializes in HBM.  Backward currently recomputes with the
-jnp reference implementation via custom_vjp (a dedicated bwd kernel is a
-later optimization); forward-only paths (serving, evaluation) get the full
-benefit.
+matrix never materializes in HBM.
+
+Forward emits the per-row softmax stats (l, m) alongside the output, and
+the backward is a *block-recompute* pass: a ``lax.scan`` over K blocks
+rebuilds each [T, block_k] probability tile from the saved stats and
+accumulates dq/dk/dv, so peak memory stays O(T·block_k) — never the full
+T x T (VERDICT r1 #5; replaces the old full jnp-recompute bwd).
+
+``flash_attention_partial`` exposes the same kernel without the final
+normalization, returning (acc, l, m) for one KV block — the building
+block ring attention folds across ``ppermute`` hops
+(parallel/ring_attention.py).  The ring's *forward* thereby skips the
+dense per-shard score matrix; its backward currently recomputes each
+ring step densely ([T/sp x T/sp] per step — bounded by the shard, the
+same peak as the jnp fold).  A blockwise partial bwd using the saved
+stats is a later optimization.
 
 Layout: [batch, heads, seq, head_dim].  Sequence and head_dim should be
 multiples of the block sizes (128 lanes); `flash_attention` falls back to
-the reference implementation for unfriendly shapes.
+the reference implementation for unfriendly shapes.  Mode selection (the
+relay in this image cannot compile Pallas — see PARITY.md):
+``ELASTICDL_FLASH=auto`` (default: compiled kernel on TPU, jnp
+elsewhere), ``interpret`` (Pallas interpret mode, for tests), ``off``.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +37,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+
+def flash_mode():
+    """"tpu" (compiled), "interpret", or "off" for the current config."""
+    mode = os.environ.get("ELASTICDL_FLASH", "auto")
+    if mode == "auto":
+        return "tpu" if jax.default_backend() == "tpu" else "off"
+    return mode
 
 
 def _attention_ref(q, k, v, causal, scale):
@@ -38,8 +62,10 @@ def _attention_ref(q, k, v, causal, scale):
     ).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
-    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, block_q, D]
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, *, block_k,
+                  causal, scale, normalize):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D];
+    # o_ref: [1, block_q, D]; l_ref/m_ref: [1, block_q]
     block_q = q_ref.shape[1]
     seq_len = k_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -80,21 +106,36 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
     l = jnp.zeros((block_q,), jnp.float32)
     m = jnp.full((block_q,), NEG_INF, jnp.float32)
     acc, l, m = jax.lax.fori_loop(0, num_k, body, (acc, l, m))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    if normalize:
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype
+        )
+    else:
+        o_ref[0] = acc.astype(o_ref.dtype)
+    l_ref[0] = l
+    m_ref[0] = m
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   normalize=True):
+    """Returns (out, l, m); out is normalized iff ``normalize``."""
     b, h, t, d = q.shape
     bh = b * h
     qr = q.reshape(bh, t, d)
     kr = k.reshape(bh, t, d)
     vr = v.reshape(bh, t, d)
     grid = (bh, t // block_q)
-    out = pl.pallas_call(
+    out_dtype = q.dtype if normalize else jnp.float32
+    out, l, m = pl.pallas_call(
         functools.partial(
-            _flash_kernel, block_k=block_k, causal=causal, scale=scale
+            _flash_kernel, block_k=block_k, causal=causal, scale=scale,
+            normalize=normalize,
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), out_dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
@@ -104,34 +145,99 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ),
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, t, d)
+    return (
+        out.reshape(b, h, t, d),
+        l.reshape(b, h, t),
+        m.reshape(b, h, t),
+    )
+
+
+def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k):
+    """Block-recompute backward: scan over K blocks rebuilding each
+    [T, block_k] probability tile from the saved (l, m) stats.  Peak
+    live memory O(B·H·T·block_k), never the T x T matrix."""
+    _, _, tk, _ = k.shape
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    outf = out.astype(jnp.float32)
+    # delta_i = sum_d dO_i O_i  (the usual flash-bwd row constant)
+    delta = (gf * outf).sum(axis=-1)                    # [B,H,T]
+    l_safe = jnp.maximum(l, 1e-30)
+    q_pos = jnp.arange(q.shape[2])
+
+    num_k = tk // block_k
+    k_blocks = k.reshape(*k.shape[:2], num_k, block_k, k.shape[3])
+    v_blocks = v.reshape(*v.shape[:2], num_k, block_k, v.shape[3])
+
+    def body(carry, inputs):
+        dq = carry
+        ki, kb, vb = inputs
+        kf = kb.astype(jnp.float32)
+        vf = vb.astype(jnp.float32)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, kf,
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [B,H,T,bk]
+        if causal:
+            k_pos = ki * block_k + jnp.arange(block_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l_safe[..., None]
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    ks = jnp.arange(num_k)
+    dq, (dk, dv) = jax.lax.scan(
+        body, dq0,
+        (ks,
+         jnp.moveaxis(k_blocks, 2, 0),
+         jnp.moveaxis(v_blocks, 2, 0)),
+    )
+    dk = jnp.moveaxis(dk, 0, 2).reshape(k.shape)
+    dv = jnp.moveaxis(dv, 0, 2).reshape(v.shape)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+    out, _, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
+    out, l, m = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, l, m)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _attention_ref(q, k, v, causal, scale), q, k, v
-    )
-    return vjp(g)
+    q, k, v, out, l, m = res
+    return _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _friendly(t, d, block_q, block_k):
+    return not (
+        t % block_q or t % block_k or (d % 128 and d not in (64, 128, 256))
+    )
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
@@ -142,6 +248,85 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
     d = q.shape[3]
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    if t % block_q or t % block_k or d % 128 and d not in (64, 128, 256):
+    if not _friendly(t, d, block_q, block_k):
         return _attention_ref(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_partial(q, k, v, causal, scale, block_q, block_k, interpret,
+                   k_offset):
+    # causal here means the diagonal (k_offset == 0) block, where the
+    # kernel's absolute-position mask equals the local mask.
+    out, l, m = _flash_forward(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret, normalize=False,
+    )
+    return out, l, m
+
+
+def _partial_ref(q, k, v, causal, scale, k_offset):
+    """Unnormalized block attention in jnp (ring-fold fallback and the
+    recompute target of the partial bwd).  Positions: q rows are local,
+    k rows offset by ``k_offset`` (ring rotation)."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = (
+            jnp.arange(tq)[:, None] >= (k_offset + jnp.arange(tk))[None, :]
+        )
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return acc, l, m
+
+
+def _flash_partial_fwd(q, k, v, causal, scale, block_q, block_k,
+                       interpret, k_offset):
+    out = _flash_partial(q, k, v, causal, scale, block_q, block_k,
+                         interpret, k_offset)
+    return out, (q, k, v)
+
+
+def _flash_partial_bwd(causal, scale, block_q, block_k, interpret,
+                       k_offset, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _partial_ref(q, k, v, causal, scale, k_offset),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_partial.defvjp(_flash_partial_fwd, _flash_partial_bwd)
+
+
+def flash_attention_partial(q, k, v, causal=True, scale=None, k_offset=0,
+                            block_q=128, block_k=128, interpret=False):
+    """Unnormalized online-softmax block attention: returns
+    (acc [B,H,T,D] f32, l [B,H,T] f32, m [B,H,T] f32) for this KV block,
+    ready to fold into a running (o, l, m) state — the per-shard step of
+    ring attention.  Causal masking compares local q rows against k rows
+    shifted by ``k_offset``.
+
+    The Pallas kernel serves k_offset == 0 (the ring's diagonal block,
+    where absolute and local positions coincide) and every non-causal
+    block; a non-zero offset (not needed by the ring's dispatch, which
+    routes lower blocks as non-causal and skips upper ones) uses the jnp
+    reference."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    t, d = q.shape[2], q.shape[3]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if (causal and k_offset != 0) or not _friendly(t, d, block_q, block_k):
+        return _partial_ref(q, k, v, causal, scale, k_offset)
+    return _flash_partial(q, k, v, causal, scale, block_q, block_k,
+                          interpret, k_offset)

@@ -8,6 +8,14 @@ accumulation flash attention uses, distributed over devices).  Peak memory
 per device is O(T/sp · T/sp) instead of O(T²), and the KV transfers ride
 ICI concurrently with compute.
 
+The per-shard block attention inside the fold is the Pallas flash
+kernel (ops/flash_attention.py ``flash_attention_partial``) when the
+platform supports it, so even the per-device T/sp x T/sp score matrix
+never materializes in the forward.  Causal folds dispatch per ring step:
+the diagonal block runs the causal kernel, blocks from lower ranks run
+the (cheaper) non-causal kernel, and blocks from higher ranks are
+skipped outright — about half the ring FLOPs for causal LMs.
+
 Layout convention: [batch, seq, heads, head_dim]; heads shard over ``tp``,
 sequence over ``sp``, batch over ``dp``.
 """
@@ -19,61 +27,105 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.ops.flash_attention import (
+    flash_attention_partial,
+    flash_mode,
+)
+
 _NEG_INF = -1e30
 
 
-def _online_block(q, k, v, o, l, m, q_pos, k_pos, scale, causal):
-    """Fold one KV block into the (o, l, m) online-softmax state."""
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]          # [Tq, Tk]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    m_new = jnp.maximum(m, s.max(axis=-1))               # [B,H,Tq]
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[..., None])                    # [B,H,Tq,Tk]
-    l = l * alpha + p.sum(axis=-1)
-    pv = jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
-    )
-    o = o * alpha.transpose(0, 2, 1)[..., None] + pv
-    return o, l, m_new
-
-
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
+def _ring_attention_local(q, k, v, axis_name, causal, scale, mode="off"):
+    """Per-device fold, [B, T/sp, H, D] shards in; the block math runs in
+    [B, H, T, D] (the flash kernel's layout) and transposes back once."""
     axis_size = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, tq, h, d = q.shape
-    tk = k.shape[1]
-    q_pos = rank * tq + jnp.arange(tq)
+    interpret = mode == "interpret"
 
-    o = jnp.zeros((b, tq, h, d), jnp.float32)
+    qT = q.transpose(0, 2, 1, 3)                         # [B,H,Tq,D]
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+
+    def partial(qT, kT, vT, block_causal):
+        if mode in ("tpu", "interpret"):
+            return flash_attention_partial(
+                qT, kT, vT, causal=block_causal, scale=scale,
+                interpret=interpret,
+            )
+        from elasticdl_tpu.ops.flash_attention import _partial_ref
+
+        return _partial_ref(qT, kT, vT, block_causal, scale, 0)
+
+    def skip_partial(qT):
+        return (
+            jnp.zeros(qT.shape, jnp.float32),
+            jnp.zeros(qT.shape[:3], jnp.float32),
+            jnp.full(qT.shape[:3], _NEG_INF, jnp.float32),
+        )
+
+    o = jnp.zeros((b, h, tq, d), jnp.float32)
     l = jnp.zeros((b, h, tq), jnp.float32)
     m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
 
+    def fold(o, l, m, acc_i, l_i, m_i):
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        l = l * alpha + l_i * beta
+        o = o * alpha[..., None] + acc_i * beta[..., None]
+        return o, l, m_new
+
     def body(i, carry):
-        o, l, m, k, v = carry
+        o, l, m, kT, vT = carry
         src_rank = (rank - i) % axis_size
-        k_pos = src_rank * tk + jnp.arange(tk)
-        o, l, m = _online_block(q, k, v, o, l, m, q_pos, k_pos, scale,
-                                causal)
+        if causal:
+            # diagonal -> causal kernel; lower source rank -> full
+            # (non-causal) kernel; higher -> entirely masked, skip.
+            acc_i, l_i, m_i = jax.lax.cond(
+                src_rank == rank,
+                lambda ops: partial(*ops, block_causal=True),
+                lambda ops: jax.lax.cond(
+                    src_rank < rank,
+                    lambda ops2: partial(*ops2, block_causal=False),
+                    lambda ops2: skip_partial(ops2[0]),
+                    ops,
+                ),
+                (qT, kT, vT),
+            )
+        else:
+            acc_i, l_i, m_i = partial(qT, kT, vT, block_causal=False)
+        o, l, m = fold(o, l, m, acc_i, l_i, m_i)
         # pass our current KV block along the ring
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-        k = jax.lax.ppermute(k, axis_name, perm)
-        v = jax.lax.ppermute(v, axis_name, perm)
-        return o, l, m, k, v
+        kT = jax.lax.ppermute(kT, axis_name, perm)
+        vT = jax.lax.ppermute(vT, axis_name, perm)
+        return o, l, m, kT, vT
 
-    o, l, m, k, v = jax.lax.fori_loop(
-        0, axis_size, body, (o, l, m, k, v)
+    o, l, m, kT, vT = jax.lax.fori_loop(
+        0, axis_size, body, (o, l, m, kT, vT)
     )
-    o = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return o.astype(q.dtype)
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def attention_local(q, k, v, causal=True, scale=None):
-    """Single-device reference attention (same layout, same math)."""
+def attention_local(q, k, v, causal=True, scale=None, mode=None):
+    """Single-device attention in ring layout [B, T, H, D].
+
+    Routes to the Pallas flash kernel (with its block-recompute bwd)
+    when the platform allows — this is the sp=1 hot path the flagship
+    transformer hits; the jnp reference covers everything else."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    mode = flash_mode() if mode is None else mode
+    if mode in ("tpu", "interpret"):
+        from elasticdl_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
+            interpret=(mode == "interpret"),
+        )
+        return o.transpose(0, 2, 1, 3).astype(q.dtype)
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -96,8 +148,36 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
     Falls back to local attention when the mesh has no sp extent.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if mesh is None or mesh.shape.get(sp_axis, 1) == 1:
+    if mesh is None:
         return attention_local(q, k, v, causal=causal, scale=scale)
+    mode = flash_mode()
+    if mesh.shape.get(sp_axis, 1) == 1:
+        dp = mesh.shape.get(dp_axis, 1)
+        tp = mesh.shape.get(tp_axis, 1)
+        if (
+            mode in ("tpu", "interpret")
+            and q.shape[0] % dp == 0
+            and q.shape[2] % tp == 0
+        ):
+            # The Pallas kernel must run INSIDE a manual shard_map over
+            # dp/tp: called under plain GSPMD, pallas_call is opaque to
+            # the partitioner, which all-gathers q/k/v and replicates
+            # the whole computation on every device.
+            spec = P(dp_axis, None, tp_axis, None)
+            fn = shard_map(
+                functools.partial(
+                    attention_local, causal=causal, scale=scale,
+                    mode=mode,
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            return fn(q, k, v)
+        return attention_local(
+            q, k, v, causal=causal, scale=scale, mode="off"
+        )
     sp = mesh.shape[sp_axis]
     tp = mesh.shape.get(tp_axis, 1)
     dp = mesh.shape.get(dp_axis, 1)
@@ -114,7 +194,7 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
     fn = shard_map(
         functools.partial(
             _ring_attention_local,
-            axis_name=sp_axis, causal=causal, scale=scale,
+            axis_name=sp_axis, causal=causal, scale=scale, mode=mode,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
