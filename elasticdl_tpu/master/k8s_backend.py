@@ -3,16 +3,38 @@
 Plugs into WorkerManager behind the same launch/wait/kill/is_alive surface
 as ProcessWorkerBackend (parity with the reference's pod manager + k8s
 client, elasticdl/python/master/pod_manager.py:207-674 and
-common/k8s_client.py:41-334).  Requires the ``kubernetes`` package and
-in-cluster (or kubeconfig) credentials; everything cluster-specific stays
-in this one module so the rest of the control plane is backend-agnostic.
+common/k8s_client.py:41-334).  Everything cluster-specific stays in this
+one module so the rest of the control plane is backend-agnostic.
 
-Pod labels follow the reference scheme: job name / replica-type /
-replica-index.  Preemption shows up as pod DELETED events, which the
-watcher maps to the same EV_PREEMPTED flow the process backend uses — so
-TPU-VM preemption drills and local kill -9 drills exercise one code path.
+Manifests are plain dicts (the k8s API accepts them directly), so the
+backend is unit-testable against a fake API object with no ``kubernetes``
+package in the image — pass ``core_api=`` to inject one; the default
+constructor imports the real client and loads in-cluster/kubeconfig
+credentials.
+
+Reference behaviors carried over:
+ - pod labels job-name / replica-type / replica-index
+   (elasticdl_client/common/k8s_client.py:29-32);
+ - a service per worker, patched to select the replacement pod when a
+   worker is relaunched under a fresh id
+   (common/k8s_client.py:261-274) — so PS/master addressing of a worker
+   slot survives relaunches;
+ - high/low worker pod priority split: the first
+   ``ceil(fraction * num_workers)`` workers get the high priority class
+   (pod_manager.py:80-99), protecting a core of the fleet from
+   preemption;
+ - a pluggable cluster-spec hook: a dotted module path exporting
+   ``patch_pod(manifest) -> manifest`` / ``patch_service(manifest) ->
+   manifest`` applied before every create, for site-specific tweaks
+   (elasticdl_client/common/k8s_client.py:106-218).
+
+Preemption shows up as pod DELETED/gone states, which ``wait`` maps to
+the same EV_PREEMPTED flow the process backend uses — so TPU-VM
+preemption drills and local kill -9 drills exercise one code path.
 """
 
+import importlib
+import math
 import threading
 
 from elasticdl_tpu.utils.logging import get_logger
@@ -24,110 +46,233 @@ LABEL_TYPE = "replica-type"
 LABEL_INDEX = "replica-index"
 
 
+def load_cluster_spec(path):
+    """Import a cluster-spec module ('pkg.mod') exporting optional
+    patch_pod / patch_service hooks."""
+    if not path:
+        return None
+    return importlib.import_module(path)
+
+
 class K8sWorkerBackend:
     def __init__(self, job_name, image, namespace="default",
-                 worker_args=None, resources=None, tpu_topology=None):
-        try:
-            from kubernetes import client, config, watch  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "K8sWorkerBackend needs the `kubernetes` package; "
-                "install it in the cluster image (the local image runs "
-                "the process backend instead)"
-            ) from e
-        from kubernetes import client, config, watch
-
-        try:
-            config.load_incluster_config()
-        except Exception:
-            config.load_kube_config()
-        self._core = client.CoreV1Api()
-        self._watch = watch.Watch()
+                 worker_args=None, resources=None, tpu_topology=None,
+                 num_workers=0, high_priority_fraction=0.0,
+                 priority_class_high="high-priority",
+                 priority_class_low="", cluster_spec="",
+                 core_api=None, poll_secs=5.0):
+        if core_api is None:
+            try:
+                from kubernetes import client, config
+            except ImportError as e:
+                raise ImportError(
+                    "K8sWorkerBackend needs the `kubernetes` package; "
+                    "install it in the cluster image (the local image "
+                    "runs the process backend instead)"
+                ) from e
+            try:
+                config.load_incluster_config()
+            except Exception:
+                config.load_kube_config()
+            core_api = client.CoreV1Api()
+        self._core = core_api
         self._job_name = job_name
         self._image = image
         self._namespace = namespace
         self._worker_args = worker_args or []
         self._resources = resources or {}
         self._tpu_topology = tpu_topology
+        self._num_workers = num_workers
+        self._high_fraction = high_priority_fraction
+        self._priority_high = priority_class_high
+        self._priority_low = priority_class_low
+        self._cluster_spec = (
+            load_cluster_spec(cluster_spec)
+            if isinstance(cluster_spec, str) else cluster_spec
+        )
+        self._poll_secs = poll_secs
         self._exit_events = {}  # pod name -> threading.Event w/ .code
 
     def _pod_name(self, worker_id):
         return "%s-worker-%d" % (self._job_name, worker_id)
 
-    def _pod_manifest(self, worker_id, master_addr):
-        from kubernetes import client
+    def _service_name(self, worker_id):
+        return self._pod_name(worker_id)
 
-        env = [
-            client.V1EnvVar(name="MASTER_ADDR", value=master_addr),
-            client.V1EnvVar(name="WORKER_ID", value=str(worker_id)),
-        ]
-        node_selector = None
-        if self._tpu_topology:
-            node_selector = {
-                "cloud.google.com/gke-tpu-topology": self._tpu_topology
-            }
-        return client.V1Pod(
-            metadata=client.V1ObjectMeta(
-                name=self._pod_name(worker_id),
-                labels={
+    def _priority_class(self, slot):
+        """First ceil(fraction*num_workers) *slots* ride the high
+        priority class (reference pod_manager.py:80-99).  Keyed by slot,
+        not launch id, so a relaunched high-priority worker keeps its
+        protection instead of eroding the protected core."""
+        if not self._high_fraction or not self._num_workers:
+            return self._priority_low or None
+        n_high = math.ceil(self._high_fraction * self._num_workers)
+        if slot < n_high:
+            return self._priority_high
+        return self._priority_low or None
+
+    def _apply_spec_hook(self, manifest, hook_name):
+        hook = getattr(self._cluster_spec, hook_name, None)
+        if hook is not None:
+            patched = hook(manifest)
+            if patched is not None:
+                manifest = patched
+        return manifest
+
+    def pod_manifest(self, worker_id, master_addr, slot=None):
+        slot = worker_id if slot is None else slot
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": self._pod_name(worker_id),
+                "labels": {
                     LABEL_JOB: self._job_name,
                     LABEL_TYPE: "worker",
                     LABEL_INDEX: str(worker_id),
                 },
-            ),
-            spec=client.V1PodSpec(
-                restart_policy="Never",
-                node_selector=node_selector,
-                containers=[
-                    client.V1Container(
-                        name="worker",
-                        image=self._image,
-                        command=["python", "-m",
-                                 "elasticdl_tpu.worker.main"],
-                        args=[str(a) for a in self._worker_args],
-                        env=env,
-                        resources=client.V1ResourceRequirements(
-                            requests=self._resources
-                        ),
-                    )
-                ],
-            ),
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "worker",
+                    "image": self._image,
+                    "command": ["python", "-m",
+                                "elasticdl_tpu.worker.main"],
+                    "args": [str(a) for a in self._worker_args],
+                    "env": [
+                        {"name": "MASTER_ADDR", "value": master_addr},
+                        {"name": "WORKER_ID", "value": str(worker_id)},
+                    ],
+                    "resources": {"requests": dict(self._resources)},
+                }],
+            },
+        }
+        if self._tpu_topology:
+            manifest["spec"]["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-topology": self._tpu_topology
+            }
+        priority = self._priority_class(slot)
+        if priority:
+            manifest["spec"]["priorityClassName"] = priority
+        return self._apply_spec_hook(manifest, "patch_pod")
+
+    def service_manifest(self, worker_id, select_worker_id=None):
+        """Service for a worker slot; ``select_worker_id`` lets a
+        relaunch re-point the original slot's service at the
+        replacement pod."""
+        target = (
+            worker_id if select_worker_id is None else select_worker_id
         )
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": self._service_name(worker_id),
+                "labels": {
+                    LABEL_JOB: self._job_name,
+                    LABEL_TYPE: "worker",
+                    LABEL_INDEX: str(worker_id),
+                },
+            },
+            "spec": {
+                "selector": {
+                    LABEL_JOB: self._job_name,
+                    LABEL_TYPE: "worker",
+                    LABEL_INDEX: str(target),
+                },
+                "ports": [{"port": 50002, "targetPort": 50002}],
+            },
+        }
+        return self._apply_spec_hook(manifest, "patch_service")
 
     # -- WorkerManager backend surface --------------------------------------
 
-    def launch(self, worker_id, master_addr):
-        pod = self._pod_manifest(worker_id, master_addr)
+    def launch(self, worker_id, master_addr, slot=None):
+        """``slot`` is the stable replica slot (WorkerHandle.slot): on a
+        relaunch it is the ORIGINAL slot id, so the slot's service keeps
+        re-pointing at each replacement no matter how many times the
+        worker dies."""
+        slot = worker_id if slot is None else slot
+        pod = self.pod_manifest(worker_id, master_addr, slot=slot)
         self._core.create_namespaced_pod(self._namespace, pod)
+        if slot != worker_id:
+            # Keep the slot's service and re-point it at the replacement
+            # (reference common/k8s_client.py:261-274).
+            self.patch_service(slot, worker_id)
+        else:
+            self._core.create_namespaced_service(
+                self._namespace, self.service_manifest(worker_id)
+            )
         event = threading.Event()
         event.code = None
         self._exit_events[self._pod_name(worker_id)] = event
         return self._pod_name(worker_id)
 
+    def patch_service(self, slot, new_worker_id):
+        body = self.service_manifest(slot, select_worker_id=new_worker_id)
+        try:
+            self._core.patch_namespaced_service(
+                self._service_name(slot), self._namespace, body
+            )
+        except Exception as e:  # noqa: BLE001 — service may be gone
+            logger.warning(
+                "patch service %s -> worker %d failed (%s); recreating",
+                self._service_name(slot), new_worker_id, e,
+            )
+            try:
+                # Self-heal: a missing/deleted slot service comes back
+                # already selecting the replacement pod.
+                self._core.create_namespaced_service(self._namespace, body)
+            except Exception as e2:  # noqa: BLE001
+                logger.warning(
+                    "recreate service %s failed: %s",
+                    self._service_name(slot), e2,
+                )
+
     def wait(self, ref):
         """Block until the pod reaches a terminal phase; return an exit
-        code (0 ok, 1 failed, -9 deleted/preempted)."""
+        code (0 ok, 1 failed, 137 OOM, -9 deleted/preempted)."""
         event = self._exit_events[ref]
-        while not event.wait(timeout=5):
+        while not event.wait(timeout=self._poll_secs):
             try:
                 pod = self._core.read_namespaced_pod(ref, self._namespace)
             except Exception:
                 event.code = -9  # pod gone: preempted/deleted
                 break
-            phase = pod.status.phase
+            phase = self._phase(pod)
             if phase == "Succeeded":
                 event.code = 0
                 break
             if phase == "Failed":
-                statuses = pod.status.container_statuses or []
-                code = 1
-                for s in statuses:
-                    term = s.state.terminated
-                    if term is not None:
-                        code = term.exit_code
-                event.code = 137 if code == 137 else code
+                event.code = self._exit_code(pod)
                 break
+        self._exit_events.pop(ref, None)  # bound long-job growth
         return event.code
+
+    @staticmethod
+    def _phase(pod):
+        if isinstance(pod, dict):
+            return pod.get("status", {}).get("phase")
+        return pod.status.phase
+
+    @staticmethod
+    def _exit_code(pod):
+        code = 1
+        if isinstance(pod, dict):
+            statuses = pod.get("status", {}).get(
+                "containerStatuses", []
+            ) or []
+            for s in statuses:
+                term = (s.get("state") or {}).get("terminated")
+                if term is not None:
+                    code = term.get("exitCode", 1)
+        else:
+            for s in pod.status.container_statuses or []:
+                term = s.state.terminated
+                if term is not None:
+                    code = term.exit_code
+        return code
 
     def kill(self, ref, force=False):
         try:
@@ -143,4 +288,4 @@ class K8sWorkerBackend:
             pod = self._core.read_namespaced_pod(ref, self._namespace)
         except Exception:
             return False
-        return pod.status.phase in ("Pending", "Running")
+        return self._phase(pod) in ("Pending", "Running")

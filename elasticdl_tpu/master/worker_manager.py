@@ -24,9 +24,13 @@ logger = get_logger(__name__)
 
 
 class WorkerHandle:
-    def __init__(self, worker_id, backend_ref):
+    def __init__(self, worker_id, backend_ref, slot=None):
         self.worker_id = worker_id
         self.backend_ref = backend_ref   # backend-specific (process, pod name)
+        # The stable "slot" a worker occupies across relaunches: worker 0
+        # dies, worker 4 replaces it, but both fill slot 0 — services and
+        # priority classes follow the slot, not the ever-increasing id.
+        self.slot = worker_id if slot is None else slot
         self.status = ws.INIT
         self.relaunch_count = 0
         self.relaunch_pending = False
@@ -39,7 +43,8 @@ class ProcessWorkerBackend:
         self._worker_args = worker_args or []
         self._env = env or {}
 
-    def launch(self, worker_id, master_addr):
+    def launch(self, worker_id, master_addr, slot=None):
+        del slot  # process workers have no service to re-point
         env = dict(os.environ)
         env.update(self._env)
         env["MASTER_ADDR"] = master_addr
@@ -106,12 +111,14 @@ class WorkerManager:
         for _ in range(self._num_workers):
             self._launch_worker()
 
-    def _launch_worker(self):
+    def _launch_worker(self, slot=None):
         with self._lock:
             worker_id = self._next_worker_id
             self._next_worker_id += 1
-            ref = self._backend.launch(worker_id, self._master_addr)
-            handle = WorkerHandle(worker_id, ref)
+            ref = self._backend.launch(
+                worker_id, self._master_addr, slot=slot
+            )
+            handle = WorkerHandle(worker_id, ref, slot=slot)
             handle.status = ws.PENDING
             self._workers[worker_id] = handle
         logger.info("launched worker %d", worker_id)
@@ -170,7 +177,7 @@ class WorkerManager:
         for fn in self._exit_callbacks:
             fn(handle.worker_id, should_relaunch)
         if should_relaunch and not self._stopped.is_set():
-            new_id = self._launch_worker()
+            new_id = self._launch_worker(slot=handle.slot)
             with self._lock:
                 self._workers[new_id].relaunch_count = (
                     handle.relaunch_count + 1

@@ -68,7 +68,7 @@ class FakeBackend:
     def __init__(self):
         self.refs = {}
 
-    def launch(self, worker_id, master_addr):
+    def launch(self, worker_id, master_addr, slot=None):
         ref = FakeRef(worker_id)
         self.refs[worker_id] = ref
         return ref
