@@ -1,0 +1,1 @@
+from elasticdl_tpu.preprocessing import analyzer_utils, feature_column  # noqa: F401

@@ -235,9 +235,16 @@ class ParameterServerTrainer(Trainer):
             raise GradientsRejected(
                 "stale gradients at version %d" % self._version
             )
-        self._version = max(self._version, version)
+        # Do NOT adopt the push response's version: _version means "the
+        # server version my local params correspond to", and our params
+        # still predate the update we just pushed.  Claiming the newer
+        # version made the next pull's `request.version < server.version`
+        # check pass vacuously, so dense params went permanently stale
+        # (caught by test_feature_column_feed_trains_through_ps; the
+        # DeepFM tests masked it because embedding pulls aren't
+        # version-gated).  _version advances only in _pull_dense.
         self._steps += 1
-        return float(loss), self._version
+        return float(loss), version
 
     def evaluate_minibatch(self, features, labels):
         n = jax.tree_util.tree_leaves(features)[0].shape[0]
