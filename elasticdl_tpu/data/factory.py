@@ -54,6 +54,17 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
         return ArrayDataReader(
             (dense, ids, labels), records_per_shard=records_per_shard
         )
+    if data_origin.startswith("imagefolder:"):
+        # "imagefolder:<root>[:<image_size>]" — ImageNet-layout dirs.
+        from elasticdl_tpu.data.image_folder import ImageFolderDataReader
+
+        parts = data_origin.split(":")
+        root = parts[1]
+        image_size = int(parts[2]) if len(parts) > 2 else 224
+        return ImageFolderDataReader(
+            root, image_size=image_size,
+            records_per_shard=records_per_shard,
+        )
     if data_origin.endswith(".csv"):
         from elasticdl_tpu.data.reader import TextDataReader
 

@@ -56,6 +56,23 @@ class SQLTableDataReader(AbstractDataReader):
         return shards
 
     def read_records(self, task):
+        if task.shard.record_indices:
+            # Shuffled task: the indices are a permutation of the
+            # shard's own range, so fetch the covering range in ONE
+            # query and reorder in memory — per-index OFFSET queries
+            # would rescan the table once per record.
+            indices = [int(i) for i in task.shard.record_indices]
+            lo, hi = min(indices), max(indices) + 1
+            cur = self._conn.execute(
+                "SELECT %s FROM %s LIMIT ? OFFSET ?"
+                % (", ".join(self._columns), self._table),
+                (hi - lo, lo),
+            )
+            rows = cur.fetchall()
+            for i in indices:
+                if 0 <= i - lo < len(rows):
+                    yield list(rows[i - lo])
+            return
         start, end = task.shard.start, task.shard.end
         cur = self._conn.execute(
             "SELECT %s FROM %s LIMIT ? OFFSET ?"

@@ -1,0 +1,124 @@
+"""Image-folder plumbing: reader, elastic dataset, recio packing
+(reference ElasticImageFolder + image recordio_gen)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.factory import create_data_reader
+from elasticdl_tpu.data.image_folder import (
+    ImageFolderDataReader,
+    pack_image_folder,
+    scan_image_folder,
+)
+from elasticdl_tpu.master.task_manager import Shard, Task
+
+
+@pytest.fixture(scope="module")
+def folder(tmp_path_factory):
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("images")
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir()
+        for i in range(6):
+            arr = (rng.rand(10, 12, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / ("%d.png" % i))
+    return str(root)
+
+
+def test_scan_sorted_and_labeled(folder):
+    samples, classes = scan_image_folder(folder)
+    assert classes == ["cat", "dog"]
+    assert len(samples) == 12
+    assert {label for _, label in samples} == {0, 1}
+
+
+def test_reader_decodes_resized_float(folder):
+    reader = ImageFolderDataReader(folder, image_size=8,
+                                   records_per_shard=5)
+    assert reader.get_size() == 12 and reader.num_classes() == 2
+    shards = reader.create_shards()
+    assert [s[1:] for s in shards] == [(0, 5), (5, 10), (10, 12)]
+    records = list(
+        reader.read_records(Task(0, Shard(folder, 0, 5), 0))
+    )
+    assert len(records) == 5
+    x, y = records[0]
+    assert x.shape == (8, 8, 3) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0 and y == 0
+    # shuffled indices honored
+    got = [
+        y for _, y in reader.read_records(
+            Task(0, Shard(folder, 0, 12, record_indices=[11, 0]), 0)
+        )
+    ]
+    assert got == [1, 0]
+
+
+def test_factory_origin(folder):
+    reader = create_data_reader("imagefolder:%s:16" % folder,
+                                records_per_shard=4)
+    x, y = next(iter(
+        reader.read_records(Task(0, Shard(folder, 0, 1), 0))
+    ))
+    assert x.shape == (16, 16, 3)
+
+
+def test_pack_image_folder_roundtrip(folder, tmp_path):
+    from elasticdl_tpu.data.reader import RecioDataReader
+    from elasticdl_tpu.data.recio_gen import decode_xy
+
+    out = str(tmp_path / "packed")
+    count, classes = pack_image_folder(folder, out, image_size=8,
+                                       records_per_file=5)
+    assert count == 12 and classes == ["cat", "dog"]
+    reader = RecioDataReader(out, decode_fn=decode_xy)
+    shards = reader.create_shards()
+    total = sum(end - start for _, start, end in shards)
+    assert total == 12
+    name, start, end = shards[0]
+    x, y = next(iter(
+        reader.read_records(Task(0, Shard(name, start, start + 1), 0))
+    ))
+    assert x.shape == (8, 8, 3) and x.dtype == np.float32
+
+
+def test_elastic_image_folder_consumes_master_indices(folder):
+    """__getitem__ ignores the sampler and pulls dynamic indices."""
+    from elasticdl_tpu.data.image_folder import ElasticImageFolder
+
+    class FakeMC:
+        def __init__(self):
+            self._indices = [3, 7]
+            self._done = False
+
+        def get_task(self, task_type=None):
+            from types import SimpleNamespace
+
+            from elasticdl_tpu.proto import elastic_pb2 as pb
+
+            if self._done:
+                return SimpleNamespace(id=-1, type=pb.NONE, shard=None,
+                                       model_version=-1)
+            self._done = True
+            return SimpleNamespace(
+                id=0, type=pb.TRAINING,
+                shard=SimpleNamespace(name="x", start=0, end=2,
+                                      record_indices=[3, 7]),
+                model_version=-1,
+            )
+
+        def report_batch_done(self, count):
+            pass
+
+        def report_task_result(self, *a, **k):
+            pass
+
+    ds = ElasticImageFolder(folder, FakeMC(), image_size=8)
+    x0, y0 = ds[999]  # sampler index ignored
+    x1, y1 = ds[0]
+    assert x0.shape == (8, 8, 3)
+    samples, _ = scan_image_folder(folder)
+    assert (y0, y1) == (samples[3][1], samples[7][1])
+    ds.stop()

@@ -1,0 +1,116 @@
+"""Multiprocess sharded reader + prefetcher (the odps_io equivalent,
+reference data/odps_io.py:71-400)."""
+
+import functools
+import sqlite3
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.parallel_reader import (
+    ParallelShardReader,
+    _make_task,
+    prefetch_batches,
+)
+from elasticdl_tpu.data.recio import RecioWriter
+from elasticdl_tpu.data.sql_reader import SQLTableDataReader
+
+
+def make_db(path, n=500):
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE t (a REAL, b INTEGER)")
+    conn.executemany(
+        "INSERT INTO t VALUES (?, ?)",
+        [(float(i), i % 7) for i in range(n)],
+    )
+    conn.commit()
+    conn.close()
+
+
+@pytest.mark.slow
+def test_parallel_sql_reads_match_sequential(tmp_path):
+    db = str(tmp_path / "t.db")
+    make_db(db, n=500)
+    factory = functools.partial(
+        SQLTableDataReader, db, "t", records_per_shard=500
+    )
+    sequential = list(factory().read_records(_make_task("t", 0, 500)))
+    with ParallelShardReader(
+        factory, num_processes=3, records_per_subrange=64
+    ) as reader:
+        parallel = list(reader.read_records(_make_task("t", 0, 500)))
+        assert parallel == sequential  # order preserved
+        # shuffled record_indices honored too
+        order = list(np.random.RandomState(0).permutation(100))
+        shuffled = list(
+            reader.read_records(_make_task("t", 0, 100, order))
+        )
+        assert shuffled == [sequential[i] for i in order]
+
+
+@pytest.mark.slow
+def test_parallel_recio_reads(tmp_path):
+    from elasticdl_tpu.data.reader import RecioDataReader
+
+    path = str(tmp_path / "data.recio")
+    with RecioWriter(path) as w:
+        for i in range(300):
+            w.write(b"r%03d" % i)
+    factory = functools.partial(RecioDataReader, str(tmp_path))
+    with ParallelShardReader(
+        factory, num_processes=2, records_per_subrange=50
+    ) as reader:
+        got = list(reader.read_records(_make_task(path, 0, 300)))
+    assert got == [b"r%03d" % i for i in range(300)]
+
+
+def test_prefetch_overlaps_and_preserves_order():
+    produced = []
+
+    def slow_batches():
+        for i in range(5):
+            time.sleep(0.02)
+            produced.append(i)
+            yield i
+
+    got = []
+    for batch in prefetch_batches(slow_batches(), depth=2):
+        time.sleep(0.02)  # "device step"
+        got.append(batch)
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_prefetch_reraises_producer_error():
+    def bad_batches():
+        yield 1
+        raise RuntimeError("disk on fire")
+
+    it = prefetch_batches(bad_batches(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        list(it)
+
+
+def test_prefetch_abandoned_consumer_unblocks_producer():
+    """Breaking out of the consumer must not leave the producer thread
+    pinned on a full queue (review r2 finding)."""
+    import threading
+
+    state = {"closed": False}
+
+    def batches():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            state["closed"] = True
+
+    gen = prefetch_batches(batches(), depth=1)
+    assert next(gen) == 0
+    gen.close()  # consumer walks away
+    deadline = time.time() + 5
+    while time.time() < deadline and not state["closed"]:
+        time.sleep(0.05)
+    assert state["closed"], "producer never released the batch iterator"
+    assert threading.active_count() < 50
