@@ -9,6 +9,8 @@ count N can be re-routed deterministically.
 
 import hashlib
 
+import numpy as np
+
 
 def string_to_id(name, num_partitions):
     h = hashlib.sha256(name.encode("utf-8")).hexdigest()
@@ -22,10 +24,13 @@ def int_to_id(value, num_partitions):
 def scatter_ids(ids, num_partitions):
     """Group a sequence of embedding ids by owning partition.
 
-    Returns {partition: [positions]} so callers can gather results back into
-    the original order.
+    Returns {partition: positions ndarray} so callers can gather results
+    back into the original order.  Vectorized — this sits on the PS
+    pull/push hot path, called once per table per minibatch.
     """
-    buckets = {}
-    for pos, value in enumerate(ids):
-        buckets.setdefault(int(value) % num_partitions, []).append(pos)
-    return buckets
+    ids = np.asarray(ids, dtype=np.int64)
+    owners = ids % num_partitions
+    return {
+        int(p): np.flatnonzero(owners == p)
+        for p in np.unique(owners)
+    }

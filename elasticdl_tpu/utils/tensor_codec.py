@@ -48,7 +48,7 @@ def indexed_slices_to_pb(values, ids, out=None):
     s = out if out is not None else pb.IndexedSlicesPB()
     ndarray_to_pb(values, out=s.values)
     del s.ids[:]
-    s.ids.extend(int(i) for i in ids)
+    s.ids.extend(np.asarray(ids, dtype=np.int64).tolist())
     return s
 
 
