@@ -89,10 +89,14 @@ class PserverServicer:
         return res
 
     def pull_embedding_vectors(self, request, _context=None):
-        with self._lock:
-            vectors = self._params.pull_embedding_vectors(
-                request.name, np.asarray(request.ids, np.int64)
-            )
+        # No servicer lock: the native table's rw-lock (kernels.cc)
+        # makes concurrent pulls and pushes on the same table
+        # well-defined, so embedding traffic from many workers no
+        # longer serializes behind dense updates — this is the RPC the
+        # 64-thread gRPC server actually fans out.
+        vectors = self._params.pull_embedding_vectors(
+            request.name, np.asarray(request.ids, np.int64)
+        )
         return tensor_codec.ndarray_to_pb(vectors)
 
     def push_gradients(self, request, _context=None):

@@ -77,10 +77,19 @@ class Worker:
         ) from err
 
     def _train_task(self, task):
+        from elasticdl_tpu.data.parallel_reader import prefetch_batches
+
         with self.timing.timeit("task_process"):
             try:
-                for features, labels, count in (
-                    self._data_service.batch_stream(task, self._batch_size)
+                # Prefetch so host-side read/decode/feed overlaps the
+                # device step (the input-pipeline half of keeping the
+                # MXU busy); producer errors re-raise here where the
+                # task-failure reporting lives.
+                for features, labels, count in prefetch_batches(
+                    self._data_service.batch_stream(
+                        task, self._batch_size
+                    ),
+                    depth=2,
                 ):
                     self._process_minibatch(features, labels)
                     self._shard_service.report_batch_done(count)

@@ -109,3 +109,57 @@ def test_task_manager_concurrent_get_report():
         counts = list(pool.map(consume, range(8)))
     assert sum(counts) == 400
     assert tm.finished()
+
+
+def test_concurrent_pulls_race_pushes_on_same_table():
+    """Embedding pulls run WITHOUT the servicer lock (round 2): hammer
+    the same table with concurrent pulls and sparse pushes and assert
+    rows are never torn — each row is either the old or the new value,
+    all-zeros or a full SGD multiple, never a mix (the native rw-lock's
+    whole-batch guarantee, kernels.cc)."""
+    client, servicers, servers = start_ps(
+        num_ps=1, opt_type="sgd", opt_args="learning_rate=1.0",
+        use_async=True,
+    )
+    try:
+        client.push_model(
+            {"w": np.zeros(2, np.float32)},
+            embedding_infos=[{"name": "emb", "dim": 8,
+                              "initializer": "zeros"}],
+        )
+        ids = np.arange(64, dtype=np.int64)
+        stop = []
+
+        def pusher():
+            try:
+                for _ in range(50):
+                    client.push_gradients(
+                        {}, {"emb": (np.full((64, 8), -1.0, np.float32),
+                                     ids)},
+                        version=0,
+                    )
+            finally:
+                # always release the pullers, even on a pusher error —
+                # otherwise the pool shutdown deadlocks the suite
+                stop.append(True)
+
+        torn = []
+
+        def puller():
+            while not stop:
+                rows = client.pull_embedding_vectors("emb", ids)
+                # each row must be a uniform SGD multiple: all 8 dims
+                # equal (every push adds +1.0 to every dim of the row)
+                spread = rows.max(axis=1) - rows.min(axis=1)
+                if (spread > 0).any():
+                    torn.append(rows)
+                    return
+
+        with ThreadPoolExecutor(5) as pool:
+            futures = [pool.submit(puller) for _ in range(4)]
+            pool.submit(pusher).result()
+            for f in futures:
+                f.result()
+        assert not torn, "observed a torn embedding row"
+    finally:
+        stop_all(servers)
