@@ -40,7 +40,7 @@ CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".jax_cache")
 
 
-def run_bench(batch_size=128, warmup=3, iters=20):
+def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
     import jax
 
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
@@ -82,7 +82,14 @@ def run_bench(batch_size=128, warmup=3, iters=20):
     ws = jax.device_put(np.ones((batch_size,), np.float32))
 
     params, opt_state = trainer._params, trainer._opt_state
-    step = trainer._train_step
+    if fused_steps > 1:
+        # Steps-per-loop: K optimizer steps in ONE XLA program, so host
+        # dispatch amortizes over K.  Small windows only — the relay's
+        # remote-compile hangs on large fused programs (see memory).
+        step = trainer.build_fused_steps(fused_steps)
+        iters = max(2, iters // fused_steps)
+    else:
+        step = trainer._train_step
     compile_start = time.perf_counter()
     params, opt_state, loss = step(params, opt_state, xs, ys, ws)
     float(loss)  # fence
@@ -99,8 +106,9 @@ def run_bench(batch_size=128, warmup=3, iters=20):
     last_loss = float(loss)  # fence
     elapsed = time.perf_counter() - start
 
-    images_per_sec = batch_size * iters / elapsed
-    ms_per_step = 1000.0 * elapsed / iters
+    steps_done = iters * max(1, fused_steps)
+    images_per_sec = batch_size * steps_done / elapsed
+    ms_per_step = 1000.0 * elapsed / steps_done
     peak = TPU_PEAK_FLOPS.get(platform)
     mfu = (
         round(images_per_sec * FLOPS_PER_IMAGE / peak, 4)
@@ -115,6 +123,7 @@ def run_bench(batch_size=128, warmup=3, iters=20):
             "platform": platform,
             "batch_size": batch_size,
             "iters": iters,
+            "fused_steps": fused_steps,
             "ms_per_step": round(ms_per_step, 2),
             "mfu_estimate": mfu,
             "compile_secs": round(compile_secs, 1),
@@ -125,12 +134,12 @@ def run_bench(batch_size=128, warmup=3, iters=20):
     }
 
 
-def _run_inner(batch_size, timeout_secs):
+def _run_inner(batch_size, timeout_secs, fused=0):
     """One watchdog'd measurement subprocess; returns (result|None, reason)."""
     try:
         proc = subprocess.run(
             [sys.executable, __file__, "--inner",
-             "--batch", str(batch_size)],
+             "--batch", str(batch_size), "--fused", str(fused)],
             capture_output=True, text=True, timeout=timeout_secs,
         )
         for line in reversed(proc.stdout.strip().splitlines()):
@@ -169,27 +178,40 @@ def _run_with_watchdog():
                         "128 bf16) measured 1390.3 img/s (9.59x baseline)",
             },
         }
-    # With a number in hand, try a larger batch on its own clock; keep
-    # whichever throughput is higher.
+    # With a number in hand, try improvements on their own clocks; keep
+    # whichever throughput is higher.  Each attempt is independent so a
+    # compile hang costs its own timeout, never the captured number.
     if (
         result["detail"].get("platform") != "cpu"
         and os.environ.get("ELASTICDL_BENCH_TRY_LARGE", "1") != "0"
     ):
-        large, reason = _run_inner(256, min(timeout_secs, 600))
-        if large is not None and (large["value"] or 0) > result["value"]:
-            large["detail"]["batch128_value"] = result["value"]
-            result = large
-        elif large is None:
-            result["detail"]["batch256_attempt"] = reason
+        attempts = (
+            ("batch256", 256, 0),
+            ("fused4", 128, 4),  # small steps-per-loop window
+        )
+        for name, batch, fused in attempts:
+            better, reason = _run_inner(
+                batch, min(timeout_secs, 600), fused=fused
+            )
+            if better is not None and (
+                (better["value"] or 0) > result["value"]
+            ):
+                better["detail"]["previous_value"] = result["value"]
+                result = better
+            elif better is None:
+                result["detail"]["%s_attempt" % name] = reason
     return result
 
 
 if __name__ == "__main__":
     if "--inner" in sys.argv:
         batch = 128
+        fused = 0
         if "--batch" in sys.argv:
             batch = int(sys.argv[sys.argv.index("--batch") + 1])
-        print(json.dumps(run_bench(batch_size=batch)))
+        if "--fused" in sys.argv:
+            fused = int(sys.argv[sys.argv.index("--fused") + 1])
+        print(json.dumps(run_bench(batch_size=batch, fused_steps=fused)))
     else:
         print(json.dumps(_run_with_watchdog()))
     sys.exit(0)

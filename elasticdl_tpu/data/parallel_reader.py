@@ -190,3 +190,15 @@ def prefetch_batches(batch_iter, depth=2):
             yield item
     finally:
         abandoned.set()
+        # Join before returning control: the caller may immediately
+        # start the next task over the SAME stateful reader (shared
+        # file handles, seek+read), and two producer threads
+        # interleaving on it would tear records.  The producer notices
+        # abandonment between batches, so this waits at most one batch
+        # read/decode.
+        thread.join(timeout=60.0)
+        if thread.is_alive():
+            logger.warning(
+                "batch-prefetch producer still running after 60s; "
+                "the reader may be wedged"
+            )

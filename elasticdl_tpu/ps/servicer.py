@@ -90,10 +90,14 @@ class PserverServicer:
 
     def pull_embedding_vectors(self, request, _context=None):
         # No servicer lock: the native table's rw-lock (kernels.cc)
-        # makes concurrent pulls and pushes on the same table
-        # well-defined, so embedding traffic from many workers no
-        # longer serializes behind dense updates — this is the RPC the
-        # 64-thread gRPC server actually fans out.
+        # makes each ROW read/write atomic, so embedding traffic from
+        # many workers no longer serializes behind dense updates — this
+        # is the RPC the 64-thread gRPC server actually fans out.
+        # Guarantee is per-row, not a cross-row snapshot: a concurrent
+        # push can land between rows of one pull (uninitialized ids take
+        # a second lock acquisition), which async SGD tolerates by
+        # design — the same per-row semantics as the reference's Go
+        # table (embedding_table.go:41-58 under RWMutex).
         vectors = self._params.pull_embedding_vectors(
             request.name, np.asarray(request.ids, np.int64)
         )

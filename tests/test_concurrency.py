@@ -114,9 +114,10 @@ def test_task_manager_concurrent_get_report():
 def test_concurrent_pulls_race_pushes_on_same_table():
     """Embedding pulls run WITHOUT the servicer lock (round 2): hammer
     the same table with concurrent pulls and sparse pushes and assert
-    rows are never torn — each row is either the old or the new value,
-    all-zeros or a full SGD multiple, never a mix (the native rw-lock's
-    whole-batch guarantee, kernels.cc)."""
+    rows are never torn — each ROW is either the old or the new value,
+    never a mix (the native rw-lock's per-row atomicity, kernels.cc).
+    Cross-row skew within one pull is allowed — async-SGD semantics,
+    matching the reference Go table's RWMutex guarantees."""
     client, servicers, servers = start_ps(
         num_ps=1, opt_type="sgd", opt_args="learning_rate=1.0",
         use_async=True,
