@@ -198,7 +198,13 @@ def prefetch_batches(batch_iter, depth=2):
         # read/decode.
         thread.join(timeout=60.0)
         if thread.is_alive():
-            logger.warning(
+            # Fail loudly: returning control would let the caller start
+            # the next task over the SAME stateful reader while this
+            # thread is still mid-read — torn records.  A wedged reader
+            # should fail the task (the master re-queues it), not
+            # corrupt the next one.
+            raise RuntimeError(
                 "batch-prefetch producer still running after 60s; "
-                "the reader may be wedged"
+                "reader wedged — failing the task instead of racing "
+                "the next one"
             )

@@ -41,6 +41,9 @@ def add_common_args(parser):
     parser.add_argument("--evaluation_steps", type=int, default=0)
     parser.add_argument("--log_loss_steps", type=int, default=100)
     parser.add_argument("--use_bf16", type=_str2bool, default=False)
+    parser.add_argument("--zero1", type=_str2bool, default=False,
+                        help="shard optimizer state over the data axis "
+                             "(ZeRO-1) in the collective trainer")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--profile_dir", default="",
                         help="write a JAX/XLA xplane trace of the worker "

@@ -106,6 +106,7 @@ def build_worker(args):
         checkpoint_steps=checkpoint_steps,
         use_bf16_compute=args.use_bf16,
         rng_seed=args.seed,
+        zero1=args.zero1,
     )
     if saver is not None:
         trainer.init_from_checkpoint()
