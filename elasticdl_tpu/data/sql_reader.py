@@ -22,8 +22,16 @@ class SQLTableDataReader(AbstractDataReader):
         self._database = database
         self._table = table
         self._records_per_shard = records_per_shard
+        # check_same_thread=False: the worker's prefetch runs
+        # read_records in a background thread.  Access is serialized in
+        # the normal path (prefetch joins its producer before the next
+        # task starts, data/parallel_reader.py); a wedged producer that
+        # outlives the 60 s join could race a new one, so only drop the
+        # guard when this sqlite build fully serializes connections
+        # (threadsafety 3 — CPython's default build).
+        _cst = sqlite3.threadsafety < 3
         self._connect = connection_factory or (
-            lambda: sqlite3.connect(database)
+            lambda: sqlite3.connect(database, check_same_thread=_cst)
         )
         self._conn = self._connect()
         cur = self._conn.execute("SELECT COUNT(*) FROM %s" % table)
