@@ -26,10 +26,16 @@ class SQLTableDataReader(AbstractDataReader):
         # read_records in a background thread.  Access is serialized in
         # the normal path (prefetch joins its producer before the next
         # task starts, data/parallel_reader.py); a wedged producer that
-        # outlives the 60 s join could race a new one, so only drop the
-        # guard when this sqlite build fully serializes connections
-        # (threadsafety 3 — CPython's default build).
-        _cst = sqlite3.threadsafety < 3
+        # outlives the 60 s join could race a new one, so keep the
+        # guard when the sqlite build does NOT fully serialize
+        # connections.  Pre-3.11 the module reports a hardcoded
+        # threadsafety of 1 regardless of the build, so trust CPython's
+        # serialized default there.
+        import sys
+
+        _cst = (
+            sys.version_info >= (3, 11) and sqlite3.threadsafety < 3
+        )
         self._connect = connection_factory or (
             lambda: sqlite3.connect(database, check_same_thread=_cst)
         )

@@ -87,6 +87,8 @@ class CollectiveTrainer(Trainer):
         self._zero1 = zero1
         self.timing = Timing(logger=logger)
         self._version = 0
+        self._ckpt_executor = None
+        self._ckpt_future = None
 
         params = spec.init_fn(jax.random.PRNGKey(rng_seed))
         self._opt_state = spec.optimizer.init(params)
@@ -358,17 +360,55 @@ class CollectiveTrainer(Trainer):
         """Params AND optimizer state (``opt/``-prefixed, mirroring
         spmd_trainer) — an elastic restore must resume the Adam/momentum
         trajectory, not restart it (reference PS slot persistence,
-        go/pkg/ps/checkpoint.go:98-133)."""
+        go/pkg/ps/checkpoint.go:98-133).
+
+        The device->host gather is synchronous (the next step's buffer
+        donation invalidates the old arrays), but the disk write runs on
+        a single background thread so the train loop only ever pays
+        transfer time, not serialization+IO.  ``flush_checkpoints``
+        joins pending writes (called at train end)."""
         with self.timing.timeit("checkpoint_save"):
             payload = dict(self.export_parameters())
             opt_named, _ = flatten_with_names(to_numpy(self._opt_state))
             payload.update({"opt/" + k: v for k, v in opt_named.items()})
-            self._checkpoint_saver.save(self._version, dense=payload)
-        logger.info("saved checkpoint at version %d", self._version)
+            if self._ckpt_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._ckpt_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ckpt-write"
+                )
+            # Join the previous write first: bounds outstanding host
+            # copies to one and guarantees its error (disk full, NFS)
+            # surfaces HERE — raising out of train_minibatch so the
+            # task fails visibly, exactly like the old synchronous save.
+            self._surface_checkpoint_errors(wait=True)
+            self._ckpt_future = self._ckpt_executor.submit(
+                self._checkpoint_saver.save, self._version, dense=payload
+            )
+        logger.info("checkpoint at version %d queued for write",
+                    self._version)
+
+    def _surface_checkpoint_errors(self, wait):
+        future = getattr(self, "_ckpt_future", None)
+        if future is None:
+            return
+        if wait or future.done():
+            self._ckpt_future = None
+            try:
+                future.result()
+            except Exception as e:  # noqa: BLE001 — IO errors
+                raise RuntimeError(
+                    "async checkpoint write failed: %s" % (e,)
+                ) from e
+
+    def flush_checkpoints(self):
+        """Join pending checkpoint writes (train end / before export)."""
+        self._surface_checkpoint_errors(wait=True)
 
     def init_from_checkpoint(self):
         if self._checkpoint_saver is None:
             return False
+        self.flush_checkpoints()
         try:
             dense, _, version = self._checkpoint_saver.load()
         except FileNotFoundError:

@@ -131,6 +131,10 @@ class Worker:
 
     def _train_end_task(self, task):
         try:
+            # Join any in-flight async checkpoint write before export
+            # callbacks read the checkpoint directory.
+            if hasattr(self._trainer, "flush_checkpoints"):
+                self._trainer.flush_checkpoints()
             for callback in self._spec.callbacks:
                 if hasattr(callback, "on_train_end"):
                     callback.on_train_end(self._trainer)

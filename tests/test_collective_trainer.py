@@ -97,6 +97,7 @@ def test_checkpoint_restore_roundtrip(spec, tmp_path):
                            checkpoint_steps=2)
     t1.train_minibatch(xs, ys)
     t1.train_minibatch(xs, ys)  # triggers checkpoint at version 2
+    t1.flush_checkpoints()      # join the async write before restoring
     t2 = CollectiveTrainer(spec, batch_size=16, checkpoint_saver=saver)
     assert t2.init_from_checkpoint()
     assert t2.version == 2
@@ -120,6 +121,7 @@ def test_restore_resumes_optimizer_trajectory(spec, tmp_path):
                            checkpoint_saver=saver, checkpoint_steps=2)
     t1.train_minibatch(xs, ys)
     t1.train_minibatch(xs, ys)  # checkpoint at version 2 (with opt state)
+    t1.flush_checkpoints()
 
     t2 = CollectiveTrainer(spec, batch_size=16, rng_seed=99,
                            checkpoint_saver=saver)
@@ -141,6 +143,7 @@ def test_restore_on_mesh_resumes_trajectory(spec, tmp_path):
                            checkpoint_saver=saver, checkpoint_steps=2)
     t1.train_minibatch(xs, ys)
     t1.train_minibatch(xs, ys)
+    t1.flush_checkpoints()
 
     t2 = CollectiveTrainer(spec, batch_size=4, mesh=make_mesh(8),
                            rng_seed=99, checkpoint_saver=saver)
@@ -184,8 +187,24 @@ def test_zero1_checkpoint_restore_roundtrip(spec, tmp_path):
     losses_ref = [ref.train_minibatch(xs, ys)[0] for _ in range(4)]
     t1.train_minibatch(xs, ys)
     t1.train_minibatch(xs, ys)
+    t1.flush_checkpoints()
     t2 = CollectiveTrainer(spec, batch_size=4, mesh=mesh, rng_seed=9,
                            zero1=True, checkpoint_saver=saver)
     assert t2.init_from_checkpoint()
     resumed = [t2.train_minibatch(xs, ys)[0] for _ in range(2)]
     np.testing.assert_allclose(resumed, losses_ref[2:], rtol=2e-4)
+
+
+def test_async_checkpoint_does_not_block_and_flushes(spec, tmp_path):
+    """Checkpoint writes run off-thread; flush joins them and the files
+    are valid afterwards."""
+    saver = CheckpointSaver(str(tmp_path))
+    xs, ys = mnist.synthetic_data(n=16, seed=23)
+    t = CollectiveTrainer(spec, batch_size=16, checkpoint_saver=saver,
+                          checkpoint_steps=1)
+    for _ in range(3):
+        t.train_minibatch(xs, ys)
+    t.flush_checkpoints()
+    assert saver.latest_version() == 3
+    d, _, _ = saver.load()
+    assert any(k.startswith("opt/") for k in d)
