@@ -141,7 +141,7 @@ def pipeline_apply(stage_fn, stage_params, x, *, mesh, num_microbatches,
         num_microbatches=num_microbatches, num_stages=S,
     )
     return jax.shard_map(
-        lambda p, xx: body(p, xx),
+        body,
         mesh=mesh,
         in_specs=(params_spec, x_spec),
         out_specs=x_spec,

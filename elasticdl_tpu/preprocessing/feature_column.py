@@ -39,6 +39,15 @@ class FeatureColumn:
         raise NotImplementedError
 
 
+def _to_floats(values, default):
+    """Float coercion with missing-value default (shared by numeric and
+    bucketized columns)."""
+    return np.asarray(
+        [default if v in ("", None) else float(v) for v in values],
+        np.float32,
+    )
+
+
 class NumericColumn(FeatureColumn):
     """Float feature, optionally normalized."""
 
@@ -56,11 +65,7 @@ class NumericColumn(FeatureColumn):
                    default=default)
 
     def transform(self, values):
-        arr = np.asarray(
-            [self._default if v in ("", None) else float(v)
-             for v in values],
-            np.float32,
-        )
+        arr = _to_floats(values, self._default)
         if self._normalizer is not None:
             arr = np.asarray(self._normalizer(arr), np.float32)
         return arr
@@ -141,12 +146,9 @@ class BucketizedColumn(CategoricalColumn):
         return cls(key, bounds, default=default)
 
     def transform(self, values):
-        arr = np.asarray(
-            [self._default if v in ("", None) else float(v)
-             for v in values],
-            np.float32,
+        return np.asarray(
+            self._disc(_to_floats(values, self._default)), np.int64
         )
-        return np.asarray(self._disc(arr), np.int64)
 
 
 class ConcatenatedCategoricalColumn(CategoricalColumn):
