@@ -1,0 +1,88 @@
+"""PS-shard lifecycle drills: launch real PS subprocesses, SIGKILL one,
+assert relaunch-with-restore serves consistent state (the PS half of the
+elasticity story; reference: PS pods protected by priority + relaunch,
+pod_manager.py:173-177, checkpoint restore go/pkg/ps/checkpoint.go)."""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.master.ps_manager import PSManager
+from elasticdl_tpu.worker.ps_client import build_ps_client
+from tests.conftest import wait_until
+
+
+def make_client(manager):
+    return build_ps_client(manager.addrs)
+
+
+@pytest.mark.slow
+def test_ps_shard_sigkill_relaunches_with_restored_state(tmp_path):
+    manager = PSManager(
+        num_ps=2, opt_type="sgd", opt_args="learning_rate=1.0",
+        checkpoint_dir=str(tmp_path), checkpoint_steps=1,
+    )
+    manager.start()
+    try:
+        client = make_client(manager)
+        client.push_model(
+            {"a": np.zeros(4, np.float32), "b": np.zeros(4, np.float32)},
+            embedding_infos=[
+                {"name": "emb", "dim": 4, "initializer": "zeros"}
+            ],
+        )
+        ids = np.arange(8, dtype=np.int64)
+        for step in range(3):
+            accepted, _ = client.push_gradients(
+                {"a": np.ones(4, np.float32),
+                 "b": np.ones(4, np.float32)},
+                {"emb": (np.ones((8, 4), np.float32), ids)},
+                version=step,
+            )
+            assert accepted
+        rows_before = client.pull_embedding_vectors("emb", ids)
+
+        victim = manager._procs[0]
+        os.kill(victim.pid, signal.SIGKILL)
+        assert wait_until(
+            lambda: manager._procs[0].pid != victim.pid
+        ), "PS shard was not relaunched"
+
+        # Fresh channel to the relaunched shard on the SAME port.
+        client2 = make_client(manager)
+        rows_after = client2.pull_embedding_vectors("emb", ids)
+        # Shard 0 owns the even ids; its rows must come back from the
+        # checkpoint, not re-initialize to zeros.
+        np.testing.assert_allclose(rows_after, rows_before, rtol=1e-6)
+        # And the relaunched shard keeps serving pushes.
+        accepted, _ = client2.push_gradients(
+            {"a": np.ones(4, np.float32), "b": np.ones(4, np.float32)},
+            {"emb": (np.ones((8, 4), np.float32), ids)}, version=9,
+        )
+        assert accepted
+    finally:
+        manager.stop()
+
+
+@pytest.mark.slow
+def test_ps_relaunch_budget_exhausts(tmp_path):
+    manager = PSManager(
+        num_ps=1, opt_type="sgd", opt_args="learning_rate=1.0",
+        max_relaunch=1,
+    )
+    manager.start()
+    try:
+        make_client(manager)  # shard is up
+        first = manager._procs[0]
+        os.kill(first.pid, signal.SIGKILL)
+        assert wait_until(lambda: manager._procs[0].pid != first.pid)
+        second = manager._procs[0]
+        os.kill(second.pid, signal.SIGKILL)
+        time.sleep(2.0)  # budget spent: no third launch
+        assert manager._procs[0].pid == second.pid
+        assert manager._procs[0].poll() is not None
+    finally:
+        manager.stop()

@@ -84,13 +84,7 @@ class FakeBackend:
         return not ref._exit.is_set()
 
 
-def wait_until(cond, timeout=5.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if cond():
-            return True
-        time.sleep(0.01)
-    return False
+from tests.conftest import wait_until
 
 
 def make_manager(num_workers=2, **kwargs):
