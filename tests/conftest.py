@@ -20,15 +20,3 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-
-import time as _time
-
-
-def wait_until(cond, timeout=10.0, interval=0.1):
-    """Poll helper shared by the fault-tolerance drills."""
-    deadline = _time.time() + timeout
-    while _time.time() < deadline:
-        if cond():
-            return True
-        _time.sleep(interval)
-    return False

@@ -12,7 +12,7 @@ import pytest
 
 from elasticdl_tpu.master.ps_manager import PSManager
 from elasticdl_tpu.worker.ps_client import build_ps_client
-from tests.conftest import wait_until
+from tests.util import wait_until
 
 
 def make_client(manager):
@@ -81,7 +81,13 @@ def test_ps_relaunch_budget_exhausts(tmp_path):
         assert wait_until(lambda: manager._procs[0].pid != first.pid)
         second = manager._procs[0]
         os.kill(second.pid, signal.SIGKILL)
-        time.sleep(2.0)  # budget spent: no third launch
+        # budget spent: the watcher reaps the corpse and declines to
+        # relaunch — join it instead of sleeping a fixed interval
+        import threading
+
+        for t in threading.enumerate():
+            if t.name.startswith("ps-watch"):
+                t.join(timeout=15)
         assert manager._procs[0].pid == second.pid
         assert manager._procs[0].poll() is not None
     finally:

@@ -84,7 +84,7 @@ class FakeBackend:
         return not ref._exit.is_set()
 
 
-from tests.conftest import wait_until
+from tests.util import wait_until
 
 
 def make_manager(num_workers=2, **kwargs):

@@ -1,0 +1,14 @@
+"""Shared test helpers (plain module, not conftest — see pytest's
+import-mode notes on importing conftest directly)."""
+
+import time
+
+
+def wait_until(cond, timeout=10.0, interval=0.1):
+    """Poll helper shared by the fault-tolerance drills."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
