@@ -69,6 +69,59 @@ class MeanSquaredError(Mean):
         )
 
 
+class MeanAbsoluteError(Mean):
+    def __init__(self):
+        super().__init__(
+            lambda outputs, labels: np.abs(
+                np.asarray(outputs).reshape(-1)
+                - np.asarray(labels).reshape(-1)
+            )
+        )
+
+
+class TopKAccuracy(Mean):
+    """Label in the top-k logits (Keras SparseTopKCategoricalAccuracy)."""
+
+    def __init__(self, k=5):
+        def fn(outputs, labels):
+            outputs = np.asarray(outputs)
+            labels = np.asarray(labels).reshape(-1)
+            topk = np.argsort(outputs, axis=-1)[:, -k:]
+            return (topk == labels[:, None]).any(axis=-1).astype(
+                np.float64
+            )
+
+        super().__init__(fn)
+
+
+class _ConfusionCounts(Metric):
+    """Shared TP/FP/FN accumulator for precision/recall."""
+
+    def __init__(self, threshold=0.5):
+        self._threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.fn = 0
+
+    def update(self, outputs, labels):
+        pred = np.asarray(outputs).reshape(-1) > self._threshold
+        truth = np.asarray(labels).reshape(-1) > 0.5
+        self.tp += int(np.sum(pred & truth))
+        self.fp += int(np.sum(pred & ~truth))
+        self.fn += int(np.sum(~pred & truth))
+
+
+class Precision(_ConfusionCounts):
+    def result(self):
+        return self.tp / max(1, self.tp + self.fp)
+
+
+class Recall(_ConfusionCounts):
+    def result(self):
+        return self.tp / max(1, self.tp + self.fn)
+
+
 class AUC(Metric):
     """Streaming ROC-AUC via fixed-bin histograms of scores."""
 

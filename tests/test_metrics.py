@@ -1,3 +1,4 @@
+import pytest
 import numpy as np
 
 from elasticdl_tpu.utils import metrics
@@ -33,3 +34,30 @@ def test_auc_perfect_and_random():
     rng = np.random.RandomState(0)
     m2.update(rng.rand(4000), rng.randint(0, 2, 4000))
     assert 0.45 < m2.result() < 0.55
+
+
+def test_precision_recall_topk_mae():
+    from elasticdl_tpu.utils.metrics import (
+        MeanAbsoluteError,
+        Precision,
+        Recall,
+        TopKAccuracy,
+    )
+
+    p, r = Precision(), Recall()
+    scores = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    for m in (p, r):
+        m.update(scores[:2], labels[:2])  # streaming in two chunks
+        m.update(scores[2:], labels[2:])
+    assert p.result() == pytest.approx(2 / 3)   # TP=2 FP=1
+    assert r.result() == pytest.approx(2 / 3)   # TP=2 FN=1
+
+    topk = TopKAccuracy(k=2)
+    logits = np.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
+    topk.update(logits, np.array([2, 1]))  # in top-2 / not in top-2
+    assert topk.result() == pytest.approx(0.5)
+
+    mae = MeanAbsoluteError()
+    mae.update(np.array([1.0, 3.0]), np.array([2.0, 1.0]))
+    assert mae.result() == pytest.approx(1.5)
