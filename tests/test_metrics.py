@@ -55,7 +55,7 @@ def test_precision_recall_topk_mae():
 
     topk = TopKAccuracy(k=2)
     logits = np.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
-    topk.update(logits, np.array([2, 1]))  # in top-2 / not in top-2
+    topk.update(logits, np.array([2, 2]))  # in top-2 / not in top-2
     assert topk.result() == pytest.approx(0.5)
 
     mae = MeanAbsoluteError()
