@@ -1,5 +1,7 @@
 """Model-zoo smoke + convergence tests across the families."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -102,3 +104,38 @@ def test_wide_deep_census_through_ps():
         assert losses[-1] < losses[0]
     finally:
         stop_all(servers)
+
+
+@pytest.mark.slow
+def test_transformer_lm_managed_job_e2e(tmp_path):
+    """The flagship LM trains through the FULL managed path: master,
+    dynamic shards over the synthetic-LM origin, worker subprocess,
+    model_params plumbing — and the loss on the structured sequences
+    drops."""
+    import subprocess
+    import sys
+
+    log = tmp_path / "job.log"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTICDL_TPU_PLATFORM"] = "cpu"
+    with open(log, "w") as f:
+        proc = subprocess.run(
+            [sys.executable, "-m", "elasticdl_tpu.master.main",
+             "--data_origin", "synthetic_lm:512:64:512",
+             "--model_zoo", "transformer",
+             "--model_params",
+             "vocab_size=512;dim=64;num_heads=4;num_layers=2;seq_len=64",
+             "--batch_size", "16", "--num_epochs", "2",
+             "--num_workers", "1", "--num_minibatches_per_task", "4",
+             "--log_loss_steps", "8"],
+            stdout=f, stderr=subprocess.STDOUT, env=env, timeout=420,
+        )
+    text = log.read_text()
+    assert proc.returncode == 0, text[-2000:]
+    assert "job finished" in text
+    import re
+
+    losses = [float(m) for m in re.findall(r"loss[=: ]+([0-9.]+)", text)]
+    assert len(losses) >= 2, text[-2000:]
+    assert losses[-1] < losses[0], losses
