@@ -28,11 +28,13 @@ class JobMonitor:
             out["world_size"] = rank.world_size
             out["rendezvous_id"] = rank.rendezvous_id
             task = self._mc.get_task(pb.EVALUATION)
-            # monitors only peek: immediately fail the task back if we
-            # were handed real work
+            # monitors only peek: hand real work straight back via the
+            # explicit requeue field so the probe never consumes a retry
+            # or counts as completion
             if task.id > 0:
                 self._mc.report_task_result(
-                    task.id, err_message="job-monitor probe"
+                    task.id, err_message="job-monitor probe",
+                    requeue=True,
                 )
             out["dispatching"] = task.id > 0 or task.type == pb.WAIT
         except Exception as e:  # noqa: BLE001

@@ -68,7 +68,8 @@ class MasterServicer:
                         self.worker_exec_counters.get(name, 0), value
                     )
         result = self._task_manager.report(
-            request.task_id, success, request.err_message
+            request.task_id, success, request.err_message,
+            requeue=request.requeue,
         )
         if (
             self._evaluation_service is not None

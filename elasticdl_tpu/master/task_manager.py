@@ -229,8 +229,13 @@ class TaskManager:
             self._doing[task.id] = (worker_id, task, time.time())
             return task
 
-    def report(self, task_id, success, err_message=""):
+    def report(self, task_id, success, err_message="", requeue=False):
         """Worker reports a task result; failed tasks are retried <=N times.
+
+        ``requeue=True`` (an explicit proto field, set by observers like
+        the job monitor) puts the task back WITHOUT consuming a retry
+        and without counting completion — the task was only peeked,
+        never worked.
 
         Returns a ReportResult.
         """
@@ -240,6 +245,10 @@ class TaskManager:
                 logger.warning("report for unknown task %d", task_id)
                 return ReportResult(False, None, False)
             worker_id, task, start_time = entry
+            if requeue:
+                logger.info("task %d handed back by observer", task_id)
+                self._todo.appendleft(task)
+                return ReportResult(False, task, False)
             if success:
                 elapsed = time.time() - start_time
                 self._max_task_completed_time = max(

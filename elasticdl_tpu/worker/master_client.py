@@ -34,9 +34,10 @@ class MasterClient:
             req.task_type = task_type
         return self._stub.get_task(req).task
 
-    def report_task_result(self, task_id, err_message="", exec_counters=None):
+    def report_task_result(self, task_id, err_message="", exec_counters=None,
+                           requeue=False):
         req = pb.ReportTaskResultRequest(
-            task_id=task_id, err_message=err_message
+            task_id=task_id, err_message=err_message, requeue=requeue
         )
         for k, v in (exec_counters or {}).items():
             req.exec_counters[k] = int(v)
