@@ -59,3 +59,73 @@ def convert_synthetic_mnist(output_dir, n=4096, records_per_file=1024):
     xs, ys = synthetic_data(n=n)
     return convert_arrays(output_dir, (xs, ys),
                           records_per_file=records_per_file)
+
+
+def convert_csv(csv_path, output_dir, label_column=-1,
+                records_per_file=2048, skip_header=False,
+                numeric_columns=None):
+    """CSV -> recio (x, y) records — the census/heart converter shape
+    (reference census_recordio_gen.py / heart_recordio_gen.py): feature
+    columns become a float vector, the label column an int32 scalar.
+
+    numeric_columns: indices of columns to keep as features; default =
+    every column except the label.  Non-numeric values hash to a float
+    bucket (the reference pre-hashes categoricals before packing).
+    """
+    import csv as _csv
+
+    from elasticdl_tpu.utils.hashing import string_to_id
+
+    rows = []
+    with open(csv_path, newline="") as f:
+        reader = _csv.reader(f)
+        if skip_header:
+            next(reader)
+        for row in reader:
+            if row:
+                rows.append(row)
+    if not rows:
+        raise ValueError("no rows in %s" % csv_path)
+    ncols = len(rows[0])
+    if not -ncols <= label_column < ncols:
+        raise ValueError(
+            "label_column %d out of range for %d columns"
+            % (label_column, ncols)
+        )
+    label_column = label_column % ncols
+    if numeric_columns is None:
+        numeric_columns = [i for i in range(ncols) if i != label_column]
+
+    def to_float(v):
+        try:
+            return float(v)
+        except ValueError:
+            return float(string_to_id(v, 1 << 16))
+
+    xs = np.asarray(
+        [[to_float(row[i]) for i in numeric_columns] for row in rows],
+        np.float32,
+    )
+    # Categorical labels ('>50K' / '<=50K') get a stable vocabulary id.
+    raw_labels = [row[label_column] for row in rows]
+    try:
+        ys = np.asarray(
+            [int(float(v)) for v in raw_labels], np.int32
+        )
+    except ValueError:
+        vocab = {v: i for i, v in enumerate(sorted(set(raw_labels)))}
+        ys = np.asarray([vocab[v] for v in raw_labels], np.int32)
+    return convert_arrays(output_dir, (xs, ys),
+                          records_per_file=records_per_file)
+
+
+def convert_ctr(output_dir, n=65536, records_per_file=4096, **kwargs):
+    """Synthetic CTR (dense, ids, label) records — the frappe/dac_ctr
+    converter shape (reference frappe_recordio_gen.py)."""
+    from elasticdl_tpu.models.deepfm import synthetic_data
+
+    dense, ids, labels = synthetic_data(n=n, **kwargs)
+    return convert_arrays(
+        output_dir, (dense, ids, labels),
+        records_per_file=records_per_file, names=("dense", "ids", "y"),
+    )

@@ -38,3 +38,57 @@ def test_readers_honor_shuffled_record_indices(tmp_path):
     task = Task(0, Shard(csv_path, 0, 4, record_indices=[3, 0, 2]), 0)
     got = list(treader.read_records(task))
     assert got == [["row3", "3"], ["row0", "0"], ["row2", "2"]]
+
+
+def test_convert_csv_and_ctr_roundtrip(tmp_path):
+    """Named converters (census/heart/frappe analogs) pack and decode."""
+    import numpy as np
+
+    from elasticdl_tpu.data.recio_gen import (
+        convert_csv,
+        convert_ctr,
+        decode_record,
+        decode_xy,
+    )
+
+    csv_path = tmp_path / "heart.csv"
+    csv_path.write_text(
+        "age,cp,thal,target\n63,typical,fixed,1\n37,atypical,normal,0\n"
+    )
+    files = convert_csv(str(csv_path), str(tmp_path / "heart_rec"),
+                        skip_header=True)
+    x, y = decode_xy(RecioReader(files[0]).read(0))
+    assert x.shape == (3,) and x.dtype == np.float32
+    assert int(y) == 1
+    assert x[0] == 63.0  # numeric column passes through
+    # categorical column hashed deterministically
+    files2 = convert_csv(str(csv_path), str(tmp_path / "heart_rec2"),
+                         skip_header=True)
+    x2, _ = decode_xy(RecioReader(files2[0]).read(0))
+    np.testing.assert_array_equal(x, x2)
+
+    files = convert_ctr(str(tmp_path / "ctr_rec"), n=64,
+                        records_per_file=32, vocab_size=100)
+    assert len(files) == 2
+    rec = decode_record(RecioReader(files[0]).read(0))
+    assert set(rec) == {"dense", "ids", "y"}
+    assert rec["ids"].dtype == np.int64
+
+
+def test_convert_csv_categorical_label_and_bad_index(tmp_path):
+    import numpy as np
+    import pytest
+
+    from elasticdl_tpu.data.recio_gen import convert_csv, decode_xy
+
+    csv_path = tmp_path / "census.csv"
+    csv_path.write_text("39,Private,<=50K\n50,Self-emp,>50K\n")
+    files = convert_csv(str(csv_path), str(tmp_path / "rec"))
+    labels = [
+        int(decode_xy(RecioReader(files[0]).read(i))[1])
+        for i in range(2)
+    ]
+    assert sorted(labels) == [0, 1]  # stable vocabulary ids
+    with pytest.raises(ValueError, match="out of range"):
+        convert_csv(str(csv_path), str(tmp_path / "rec2"),
+                    label_column=10)
