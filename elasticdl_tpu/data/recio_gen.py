@@ -80,13 +80,19 @@ def convert_csv(csv_path, output_dir, label_column=-1,
     with open(csv_path, newline="") as f:
         reader = _csv.reader(f)
         if skip_header:
-            next(reader)
+            next(reader, None)
         for row in reader:
             if row:
                 rows.append(row)
     if not rows:
         raise ValueError("no rows in %s" % csv_path)
     ncols = len(rows[0])
+    for i, row in enumerate(rows):
+        if len(row) != ncols:
+            raise ValueError(
+                "ragged CSV: row %d has %d columns, expected %d"
+                % (i + 1, len(row), ncols)
+            )
     if not -ncols <= label_column < ncols:
         raise ValueError(
             "label_column %d out of range for %d columns"
@@ -96,25 +102,47 @@ def convert_csv(csv_path, output_dir, label_column=-1,
     if numeric_columns is None:
         numeric_columns = [i for i in range(ncols) if i != label_column]
 
+    import math
+
     def to_float(v):
         try:
-            return float(v)
+            x = float(v)
         except ValueError:
             return float(string_to_id(v, 1 << 16))
+        # literal "nan"/"inf" strings are categorical markers, not
+        # features — bucket them like any other string
+        return x if math.isfinite(x) else float(string_to_id(v, 1 << 16))
 
     xs = np.asarray(
         [[to_float(row[i]) for i in numeric_columns] for row in rows],
         np.float32,
     )
-    # Categorical labels ('>50K' / '<=50K') get a stable vocabulary id.
+    # Labels: all-numeric passes through; all-categorical ('>50K' /
+    # '<=50K') gets stable vocabulary ids.  A MIX is ambiguous (one
+    # stray '?' would silently renumber every numeric class), so it
+    # errors instead of guessing.
     raw_labels = [row[label_column] for row in rows]
-    try:
-        ys = np.asarray(
-            [int(float(v)) for v in raw_labels], np.int32
-        )
-    except ValueError:
+
+    def numeric_label(v):
+        try:
+            return int(float(v))
+        except ValueError:
+            return None
+
+    parsed = [numeric_label(v) for v in raw_labels]
+    if all(p is not None for p in parsed):
+        ys = np.asarray(parsed, np.int32)
+    elif all(p is None for p in parsed):
         vocab = {v: i for i, v in enumerate(sorted(set(raw_labels)))}
         ys = np.asarray([vocab[v] for v in raw_labels], np.int32)
+    else:
+        bad = sorted({
+            v for v, p in zip(raw_labels, parsed) if p is None
+        })[:5]
+        raise ValueError(
+            "label column mixes numeric and non-numeric values "
+            "(e.g. %s); clean the data or choose another column" % bad
+        )
     return convert_arrays(output_dir, (xs, ys),
                           records_per_file=records_per_file)
 

@@ -92,3 +92,36 @@ def test_convert_csv_categorical_label_and_bad_index(tmp_path):
     with pytest.raises(ValueError, match="out of range"):
         convert_csv(str(csv_path), str(tmp_path / "rec2"),
                     label_column=10)
+
+
+def test_convert_csv_edge_cases(tmp_path):
+    import pytest
+
+    from elasticdl_tpu.data.recio_gen import convert_csv
+
+    ragged = tmp_path / "ragged.csv"
+    ragged.write_text("1,2,0\n1,2\n")
+    with pytest.raises(ValueError, match="ragged"):
+        convert_csv(str(ragged), str(tmp_path / "r1"))
+
+    mixed = tmp_path / "mixed.csv"
+    mixed.write_text("1,2,0\n1,2,?\n")
+    with pytest.raises(ValueError, match="mixes numeric"):
+        convert_csv(str(mixed), str(tmp_path / "r2"))
+
+    empty = tmp_path / "empty.csv"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="no rows"):
+        convert_csv(str(empty), str(tmp_path / "r3"),
+                    skip_header=True)
+
+    # literal "nan" feature buckets instead of poisoning with NaN
+    import numpy as np
+
+    from elasticdl_tpu.data.recio_gen import decode_xy
+
+    nan_csv = tmp_path / "nan.csv"
+    nan_csv.write_text("nan,1,0\n2.0,3,1\n")
+    files = convert_csv(str(nan_csv), str(tmp_path / "r4"))
+    x, _ = decode_xy(RecioReader(files[0]).read(0))
+    assert np.isfinite(x).all()

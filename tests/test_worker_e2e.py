@@ -103,3 +103,49 @@ def test_worker_death_tasks_recovered(dataset):
         assert counts["completed"][pb.TRAINING] == 4
     finally:
         master.stop()
+
+
+@pytest.mark.slow
+def test_predict_job_writes_outputs(tmp_path):
+    """Train -> checkpoint -> predict: the managed predict job restores
+    the checkpoint and writes one npz of predictions per worker."""
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTICDL_TPU_PLATFORM"] = "cpu"
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        sys.executable, "-m", "elasticdl_tpu.master.main",
+        "--model_zoo", "mnist", "--batch_size", "32",
+        "--num_workers", "1", "--num_minibatches_per_task", "4",
+        "--checkpoint_dir", ckpt,
+    ]
+    train = subprocess.run(
+        base + ["--data_origin", "synthetic_mnist:256",
+                "--checkpoint_steps", "4"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert train.returncode == 0, train.stderr[-2000:]
+
+    outputs = str(tmp_path / "preds")
+    predict = subprocess.run(
+        base + ["--job_type", "predict",
+                "--data_origin", "synthetic_mnist:96",
+                "--prediction_outputs", outputs],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert predict.returncode == 0, predict.stderr[-2000:]
+    files = [f for f in os.listdir(outputs) if f.endswith(".npz")]
+    assert files, "no prediction outputs written"
+    total = 0
+    for f in files:
+        with np.load(os.path.join(outputs, f)) as z:
+            preds = z["predictions"]
+            assert preds.shape[-1] == 10  # mnist logits
+            total += preds.shape[0]
+    assert total == 96
