@@ -149,3 +149,38 @@ def test_predict_job_writes_outputs(tmp_path):
             assert preds.shape[-1] == 10  # mnist logits
             total += preds.shape[0]
     assert total == 96
+
+
+@pytest.mark.slow
+def test_evaluate_job_reports_metrics(tmp_path):
+    """Train -> checkpoint -> standalone evaluate job: metrics are
+    aggregated and logged by the master's evaluation service."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTICDL_TPU_PLATFORM"] = "cpu"
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        sys.executable, "-m", "elasticdl_tpu.master.main",
+        "--model_zoo", "mnist", "--batch_size", "32",
+        "--num_workers", "1", "--num_minibatches_per_task", "4",
+        "--checkpoint_dir", ckpt,
+    ]
+    train = subprocess.run(
+        base + ["--data_origin", "synthetic_mnist:256",
+                "--checkpoint_steps", "4"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert train.returncode == 0, train.stderr[-2000:]
+    ev = subprocess.run(
+        base + ["--job_type", "evaluate",
+                "--data_origin", "synthetic_mnist:96"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert ev.returncode == 0, ev.stderr[-2000:]
+    text = ev.stdout + ev.stderr
+    assert "job finished" in text
+    assert "accuracy" in text, text[-2000:]
