@@ -50,7 +50,7 @@ def train(master_client, n_records=512, batch_size=32, lr=1e-2):
         loss = F.cross_entropy(logits, batch_y)
         loss.backward()
         optimizer.step()
-        return float(loss)
+        return float(loss.detach())
 
     elastic_train = controller.elastic_run(train_one_batch)
 
