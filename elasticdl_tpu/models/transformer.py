@@ -326,13 +326,21 @@ def forward(params, tokens, cfg, mesh=None, return_aux=False):
 
 
 def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
-                      remat=False):
+                      remat=False, return_aux=False):
     """Microbatch-pipelined forward over the ``pp`` mesh axis.
 
     The layer stack runs as a GPipe schedule (parallel/pipeline.py):
     S = mesh.shape['pp'] stages compute concurrently on different
     microbatches, activations hopping stages via ppermute.  Bubble
-    fraction is (S-1)/(M+S-1) — S=2, M=8 -> 11.1%.  Embedding lookup and
+    fraction is (S-1)/(M+S-1) — S=2, M=8 -> 11.1%.  With ``return_aux``
+    the MoE load-balance loss is the MEAN OF PER-MICROBATCH auxes
+    (bubble ticks masked) — the objective microbatched MoE setups
+    (GPipe / gradient accumulation) train with.  The Switch statistic
+    is quadratic in batch means, so this differs slightly from the
+    full-batch value and depends on M; accumulating the linear
+    per-expert (frac, prob) vectors and combining after the loop would
+    recover the exact full-batch statistic (future work).  Embedding
+    lookup and
     the LM head run replicated over pp outside the pipeline (their FLOPs
     are small next to the stack).  Attention is per-shard local inside a
     stage, so this path requires sp=1; dp/tp compose as auto axes.
@@ -357,23 +365,28 @@ def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
             # attention_mode="off": inside the pp-manual shard_map the
             # dp/tp axes are auto, and a pallas_call under auto axes
             # would be all-gathered by GSPMD; the jnp path partitions.
-            # MoE aux losses are dropped on the pipelined path (the
-            # fill/drain ticks would pollute the statistic).
-            x, _aux = _layer_body(
+            return _layer_body(
                 x, w1, cfg, None, positions, attention_mode="off"
             )
-            return x, None
 
-        x_mb, _ = jax.lax.scan(body, x_mb, w)
-        return x_mb
+        x_mb, aux_per_layer = jax.lax.scan(body, x_mb, w)
+        # Sum this stage's layers; the pipeline masks bubble ticks and
+        # averages over microbatches, stages sum via psum.
+        return x_mb, aux_per_layer.sum()
 
     xm = split_microbatches(x, num_microbatches)
-    ym = pipeline_apply(
+    ym, aux_sum = pipeline_apply(
         stage_fn, params["layers"], xm, mesh=mesh,
-        num_microbatches=num_microbatches, remat=remat,
+        num_microbatches=num_microbatches, remat=remat, with_aux=True,
     )
     x = merge_microbatches(ym)
-    return _head(params, x, cfg)
+    logits = _head(params, x, cfg)
+    if return_aux:
+        # aux_sum is summed over ALL layers (stages x per-stage layers),
+        # averaged over microbatches; normalize to mean-per-layer to
+        # match forward(return_aux=True).
+        return logits, aux_sum / cfg.num_layers
+    return logits
 
 
 def next_token_loss(logits, tokens):
@@ -415,15 +428,6 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
             "with pp>1 and sp=1; using the scanned forward",
             stacklevel=2,
         )
-    if pipelined and moe_experts:
-        import warnings
-
-        warnings.warn(
-            "pipelined MoE drops the aux load-balance loss (not "
-            "collected across pipeline stages yet); watch expert "
-            "utilization",
-            stacklevel=2,
-        )
 
     def init_fn(rng):
         params = init_params(rng, cfg)
@@ -434,7 +438,8 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
     def apply_fn(params, tokens, train):
         if pipelined:
             return forward_pipelined(
-                params, tokens, cfg, mesh, pipeline_microbatches
+                params, tokens, cfg, mesh, pipeline_microbatches,
+                return_aux=bool(cfg.moe_experts and train),
             )
         if cfg.moe_experts and train:
             return forward(params, tokens, cfg, mesh=mesh,

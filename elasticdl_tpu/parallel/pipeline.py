@@ -49,7 +49,13 @@ from jax.sharding import PartitionSpec as P
 def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
                    num_stages):
     """Body run inside shard_map: x is [M, mb...] (replicated over pp),
-    stage_params is this device's layer slice."""
+    stage_params is this device's layer slice.
+
+    stage_fn may return either ``y`` or ``(y, aux)`` where aux is a
+    per-microbatch scalar (e.g. an MoE load-balance term for this
+    stage's layers); aux from bubble ticks (fill/drain garbage) is
+    masked out and the per-real-tick mean comes back with the outputs.
+    """
     S = num_stages
     M = num_microbatches
     stage = jax.lax.axis_index(axis)
@@ -61,16 +67,30 @@ def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
         jnp.zeros(x.shape[1:], x.dtype), (axis,), to="varying"
     )
     outputs = jax.lax.pcast(jnp.zeros_like(x), (axis,), to="varying")
+    aux_total = jax.lax.pcast(
+        jnp.zeros((), jnp.float32), (axis,), to="varying"
+    )
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_total = carry
         # Stage 0 ingests microbatch t (clamped during drain: its result
         # is never written, just keeps shapes static).
         inject = jax.lax.dynamic_index_in_dim(
             x, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
         )
         state = jnp.where(stage == 0, inject, state)
-        state = stage_fn(stage_params, state)
+        result = stage_fn(stage_params, state)
+        if isinstance(result, tuple):
+            state, aux = result
+        else:
+            state, aux = result, jnp.float32(0.0)
+        # This tick's work is real iff this stage is processing an
+        # actual microbatch (0 <= t - stage < M); bubbles compute on
+        # clamped garbage and must not pollute the aux statistic.
+        is_real = jnp.logical_and(t - stage >= 0, t - stage < M)
+        aux_total = aux_total + jnp.where(
+            is_real, aux.astype(jnp.float32), 0.0
+        )
         # The last stage commits microbatch t-(S-1) once it's real.
         out_idx = jnp.clip(t - (S - 1), 0, M - 1)
         is_commit = jnp.logical_and(stage == S - 1, t >= S - 1)
@@ -84,25 +104,31 @@ def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
         # stage 0 overwrites with the next inject).
         perm = [(i, (i + 1) % S) for i in range(S)]
         state = jax.lax.ppermute(state, axis, perm)
-        return (state, outputs), None
+        return (state, outputs, aux_total), None
 
-    (state, outputs), _ = jax.lax.scan(
-        tick, (state, outputs), jnp.arange(ticks)
+    (state, outputs, aux_total), _ = jax.lax.scan(
+        tick, (state, outputs, aux_total), jnp.arange(ticks)
     )
     # Only the last stage holds real outputs; zero-mask + psum broadcasts
     # them to every stage so downstream (loss/head) computation is
-    # replicated over pp.
+    # replicated over pp.  The aux sums across stages (each stage owns
+    # disjoint layers) and averages over microbatches.
     outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
-    return jax.lax.psum(outputs, axis)
+    aux_mean = jax.lax.psum(aux_total, axis) / M
+    return jax.lax.psum(outputs, axis), aux_mean
 
 
 def pipeline_apply(stage_fn, stage_params, x, *, mesh, num_microbatches,
-                   axis="pp", params_spec=None, x_spec=None, remat=False):
+                   axis="pp", params_spec=None, x_spec=None, remat=False,
+                   with_aux=False):
     """Apply a stacked-layer model as an S-stage microbatch pipeline.
 
-    stage_fn: (layer_params_slice, x_mb) -> y_mb; applies this stage's
-        share of the layer stack (usually an inner ``lax.scan`` over the
-        [num_layers / S] leading axis of its params slice).
+    stage_fn: (layer_params_slice, x_mb) -> y_mb or (y_mb, aux_scalar);
+        applies this stage's share of the layer stack (usually an inner
+        ``lax.scan`` over the [num_layers / S] leading axis of its
+        params slice).  With ``with_aux=True`` the call returns
+        (y, aux_mean) where aux_mean sums stages' aux (disjoint layers)
+        and averages over real microbatches (bubble ticks masked out).
     stage_params: pytree whose leaves lead with the stacked-layer axis,
         sharded over ``axis`` (default P(axis) on dim 0).
     x: [M, microbatch...] — the caller splits its batch into M
@@ -140,14 +166,15 @@ def pipeline_apply(stage_fn, stage_params, x, *, mesh, num_microbatches,
         _spmd_pipeline, fn, axis=axis,
         num_microbatches=num_microbatches, num_stages=S,
     )
-    return jax.shard_map(
+    y, aux = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(params_spec, x_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()),
         axis_names={axis},  # pp is manual; dp/tp/sp/ep stay auto
         check_vma=True,
     )(stage_params, x)
+    return (y, aux) if with_aux else y
 
 
 def split_microbatches(batch, num_microbatches):
