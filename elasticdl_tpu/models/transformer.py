@@ -195,10 +195,14 @@ def _moe_ffn(h, w, cfg, mesh):
 
     # Switch aux loss from the top-1 assignment (computed before
     # capacity so it reflects router intent, not dispatch truncation).
+    # frac/mean_probs are the LINEAR sufficient statistics — callers
+    # that accumulate across microbatches (the pipeline) combine them
+    # at the end for the exact full-batch aux.
     top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), X,
                           dtype=jnp.float32)
     frac_tokens = top1.mean(axis=(0, 1))                  # [X]
     mean_probs = probs.mean(axis=(0, 1))                  # [X]
+    stats = jnp.stack([frac_tokens, mean_probs])          # [2, X]
     aux = X * jnp.sum(frac_tokens * mean_probs)
 
     gate_vals, experts = jax.lax.top_k(probs, K)          # [B,T,K]
@@ -244,7 +248,7 @@ def _moe_ffn(h, w, cfg, mesh):
                    w["w_down"].astype(h.dtype))
     out = jnp.einsum("btxc,xbce->bte", combine,
                      y.astype(jnp.float32))
-    return out.astype(h.dtype), aux
+    return out.astype(h.dtype), aux, stats
 
 
 def _constrain(x, mesh, spec):
@@ -255,9 +259,12 @@ def _constrain(x, mesh, spec):
     return x
 
 
-def _layer_body(x, w, cfg, mesh, positions, attention_mode=None):
+def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
+                moe_stats=False):
     """One transformer block; shared by the scanned stack (forward) and
-    the per-stage slice scan (forward_pipelined)."""
+    the per-stage slice scan (forward_pipelined).  ``moe_stats`` swaps
+    the scalar aux for the linear [2, X] router statistics (pipeline
+    accumulation)."""
     compute_dtype = jnp.dtype(cfg.dtype)
     act_spec = P("dp", "sp", None)
     B, T = x.shape[0], x.shape[1]
@@ -280,8 +287,10 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None):
     )
     h = _rmsnorm(x, w["ln2"].astype(compute_dtype))
     if cfg.moe_experts:
-        moe_out, aux = _moe_ffn(h, w, cfg, mesh)
+        moe_out, aux, stats = _moe_ffn(h, w, cfg, mesh)
         x = x + _constrain(moe_out, mesh, act_spec)
+        if moe_stats:
+            return x, stats
     else:
         gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
         up = h @ w["w_up"].astype(compute_dtype)
@@ -333,13 +342,11 @@ def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
     S = mesh.shape['pp'] stages compute concurrently on different
     microbatches, activations hopping stages via ppermute.  Bubble
     fraction is (S-1)/(M+S-1) — S=2, M=8 -> 11.1%.  With ``return_aux``
-    the MoE load-balance loss is the MEAN OF PER-MICROBATCH auxes
-    (bubble ticks masked) — the objective microbatched MoE setups
-    (GPipe / gradient accumulation) train with.  The Switch statistic
-    is quadratic in batch means, so this differs slightly from the
-    full-batch value and depends on M; accumulating the linear
-    per-expert (frac, prob) vectors and combining after the loop would
-    recover the exact full-batch statistic (future work).  Embedding
+    the MoE load-balance loss equals the EXACT full-batch Switch
+    statistic: stages accumulate the linear per-expert (frac, prob)
+    sufficient statistics over real ticks (bubbles masked) and combine
+    them after the loop, so the objective is identical to the scanned
+    forward's and independent of the microbatch count.  Embedding
     lookup and
     the LM head run replicated over pp outside the pipeline (their FLOPs
     are small next to the stack).  Attention is per-shard local inside a
@@ -360,31 +367,51 @@ def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
     x = params["embed"].astype(compute_dtype)[tokens]
     positions = jnp.arange(tokens.shape[1])
 
+    collect_aux = bool(return_aux and cfg.moe_experts)
+
     def stage_fn(w, x_mb):
         def body(x, w1):
             # attention_mode="off": inside the pp-manual shard_map the
             # dp/tp axes are auto, and a pallas_call under auto axes
             # would be all-gathered by GSPMD; the jnp path partitions.
             return _layer_body(
-                x, w1, cfg, None, positions, attention_mode="off"
+                x, w1, cfg, None, positions, attention_mode="off",
+                moe_stats=collect_aux,
             )
 
         x_mb, aux_per_layer = jax.lax.scan(body, x_mb, w)
-        # Sum this stage's layers; the pipeline masks bubble ticks and
-        # averages over microbatches, stages sum via psum.
-        return x_mb, aux_per_layer.sum()
+        if collect_aux:
+            return x_mb, aux_per_layer  # [L_stage, 2, X] router stats
+        return x_mb
+
+    def finalize(stats, num_mb):
+        # stats: [L_stage, 2, X] SUMS of per-microbatch (frac, prob)
+        # means.  /M gives the full-batch means (equal microbatch
+        # sizes), so this stage's layers contribute their EXACT Switch
+        # aux — no dependence on M.
+        f = stats[:, 0] / num_mb
+        p = stats[:, 1] / num_mb
+        return (cfg.moe_experts * (f * p).sum(-1)).sum()
 
     xm = split_microbatches(x, num_microbatches)
-    ym, aux_sum = pipeline_apply(
-        stage_fn, params["layers"], xm, mesh=mesh,
-        num_microbatches=num_microbatches, remat=remat, with_aux=True,
-    )
+    if collect_aux:
+        ym, aux_sum = pipeline_apply(
+            stage_fn, params["layers"], xm, mesh=mesh,
+            num_microbatches=num_microbatches, remat=remat,
+            with_aux=True, aux_finalize=finalize,
+        )
+    else:
+        ym = pipeline_apply(
+            stage_fn, params["layers"], xm, mesh=mesh,
+            num_microbatches=num_microbatches, remat=remat,
+        )
     x = merge_microbatches(ym)
     logits = _head(params, x, cfg)
     if return_aux:
-        # aux_sum is summed over ALL layers (stages x per-stage layers),
-        # averaged over microbatches; normalize to mean-per-layer to
-        # match forward(return_aux=True).
+        if not collect_aux:  # dense model asked for aux: trivially zero
+            return logits, jnp.float32(0.0)
+        # aux_sum covers ALL layers (stages sum via psum); normalize to
+        # mean-per-layer to match forward(return_aux=True).
         return logits, aux_sum / cfg.num_layers
     return logits
 

@@ -47,14 +47,16 @@ from jax.sharding import PartitionSpec as P
 
 
 def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
-                   num_stages):
+                   num_stages, aux_finalize=None):
     """Body run inside shard_map: x is [M, mb...] (replicated over pp),
     stage_params is this device's layer slice.
 
     stage_fn may return either ``y`` or ``(y, aux)`` where aux is a
-    per-microbatch scalar (e.g. an MoE load-balance term for this
-    stage's layers); aux from bubble ticks (fill/drain garbage) is
-    masked out and the per-real-tick mean comes back with the outputs.
+    scalar or any pytree of arrays (e.g. per-expert router statistics
+    for this stage's layers).  Aux from bubble ticks (fill/drain
+    garbage) is masked out; real ticks SUM into an accumulator, which
+    ``aux_finalize(tree, M)`` reduces to this stage's scalar (default:
+    scalar / M, the per-microbatch mean) before the cross-stage psum.
     """
     S = num_stages
     M = num_microbatches
@@ -67,9 +69,22 @@ def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
         jnp.zeros(x.shape[1:], x.dtype), (axis,), to="varying"
     )
     outputs = jax.lax.pcast(jnp.zeros_like(x), (axis,), to="varying")
-    aux_total = jax.lax.pcast(
-        jnp.zeros((), jnp.float32), (axis,), to="varying"
+    # Discover the aux structure (if any) without running the stage.
+    out_aval = jax.eval_shape(stage_fn, stage_params, state)
+    has_aux = isinstance(out_aval, tuple)
+    aux_zero = (
+        jax.tree_util.tree_map(
+            lambda a: jax.lax.pcast(
+                jnp.zeros(a.shape, jnp.float32), (axis,), to="varying"
+            ),
+            out_aval[1],
+        )
+        if has_aux
+        else jax.lax.pcast(
+            jnp.zeros((), jnp.float32), (axis,), to="varying"
+        )
     )
+    aux_total = aux_zero
 
     def tick(carry, t):
         state, outputs, aux_total = carry
@@ -80,16 +95,19 @@ def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
         )
         state = jnp.where(stage == 0, inject, state)
         result = stage_fn(stage_params, state)
-        if isinstance(result, tuple):
+        if has_aux:
             state, aux = result
         else:
-            state, aux = result, jnp.float32(0.0)
+            state, aux = result, aux_zero
         # This tick's work is real iff this stage is processing an
         # actual microbatch (0 <= t - stage < M); bubbles compute on
         # clamped garbage and must not pollute the aux statistic.
         is_real = jnp.logical_and(t - stage >= 0, t - stage < M)
-        aux_total = aux_total + jnp.where(
-            is_real, aux.astype(jnp.float32), 0.0
+        aux_total = jax.tree_util.tree_map(
+            lambda tot, a: tot + jnp.where(
+                is_real, a.astype(jnp.float32), 0.0
+            ),
+            aux_total, aux,
         )
         # The last stage commits microbatch t-(S-1) once it's real.
         out_idx = jnp.clip(t - (S - 1), 0, M - 1)
@@ -111,24 +129,36 @@ def _spmd_pipeline(stage_fn, stage_params, x, axis, num_microbatches,
     )
     # Only the last stage holds real outputs; zero-mask + psum broadcasts
     # them to every stage so downstream (loss/head) computation is
-    # replicated over pp.  The aux sums across stages (each stage owns
-    # disjoint layers) and averages over microbatches.
+    # replicated over pp.  The aux reduces to a per-stage scalar FIRST
+    # (aux_finalize sees this stage's accumulated tree — its own layers
+    # only) and then sums across stages, which own disjoint layers.
     outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
-    aux_mean = jax.lax.psum(aux_total, axis) / M
-    return jax.lax.psum(outputs, axis), aux_mean
+    if aux_finalize is not None:
+        stage_aux = aux_finalize(aux_total, M)
+    else:
+        stage_aux = aux_total / M  # scalar channel: per-microbatch mean
+    aux_out = jax.lax.psum(stage_aux, axis)
+    return jax.lax.psum(outputs, axis), aux_out
 
 
 def pipeline_apply(stage_fn, stage_params, x, *, mesh, num_microbatches,
                    axis="pp", params_spec=None, x_spec=None, remat=False,
-                   with_aux=False):
+                   with_aux=False, aux_finalize=None):
     """Apply a stacked-layer model as an S-stage microbatch pipeline.
 
-    stage_fn: (layer_params_slice, x_mb) -> y_mb or (y_mb, aux_scalar);
-        applies this stage's share of the layer stack (usually an inner
+    stage_fn: (layer_params_slice, x_mb) -> y_mb or (y_mb, aux); applies
+        this stage's share of the layer stack (usually an inner
         ``lax.scan`` over the [num_layers / S] leading axis of its
-        params slice).  With ``with_aux=True`` the call returns
-        (y, aux_mean) where aux_mean sums stages' aux (disjoint layers)
-        and averages over real microbatches (bubble ticks masked out).
+        params slice).  ``aux`` may be a scalar or any pytree of arrays;
+        it is summed over REAL ticks (bubbles masked).  With
+        ``with_aux=True`` the call returns (y, aux_out):
+        - default: aux must be a scalar; aux_out = sum over stages of
+          (stage's aux sum / M) — the per-microbatch mean.
+        - ``aux_finalize(aux_tree, M) -> scalar``: applied per stage to
+          its accumulated tree before the cross-stage sum — this is how
+          callers recover EXACT full-batch statistics that are nonlinear
+          in the batch (accumulate the linear sufficient statistics,
+          combine at the end; see transformer MoE).
     stage_params: pytree whose leaves lead with the stacked-layer axis,
         sharded over ``axis`` (default P(axis) on dim 0).
     x: [M, microbatch...] — the caller splits its batch into M
@@ -165,6 +195,7 @@ def pipeline_apply(stage_fn, stage_params, x, *, mesh, num_microbatches,
     body = functools.partial(
         _spmd_pipeline, fn, axis=axis,
         num_microbatches=num_microbatches, num_stages=S,
+        aux_finalize=aux_finalize,
     )
     y, aux = jax.shard_map(
         body,

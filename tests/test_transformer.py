@@ -180,12 +180,12 @@ def test_moe_aux_loss_signals_imbalance():
         }
 
     balanced = expert_weights(rng.randn(E, X) * 0.02)
-    _, aux_balanced = tfm._moe_ffn(h, balanced, cfg, None)
+    _, aux_balanced, _ = tfm._moe_ffn(h, balanced, cfg, None)
 
     w_collapse = np.zeros((E, X))
     w_collapse[:, 0] = 10.0  # every (positive) token votes expert 0
     collapsed = expert_weights(w_collapse)
-    _, aux_collapsed = tfm._moe_ffn(h, collapsed, cfg, None)
+    _, aux_collapsed, _ = tfm._moe_ffn(h, collapsed, cfg, None)
 
     assert float(aux_balanced) < 1.5, float(aux_balanced)
     assert float(aux_collapsed) > 3.0, float(aux_collapsed)  # ~X=4
