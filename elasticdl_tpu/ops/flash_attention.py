@@ -20,9 +20,11 @@ ring step densely ([T/sp x T/sp] per step — bounded by the shard, the
 same peak as the jnp fold).  A blockwise partial bwd using the saved
 stats is a later optimization.
 
-Layout: [batch, heads, seq, head_dim].  Sequence and head_dim should be
-multiples of the block sizes (128 lanes); `flash_attention` falls back to
-the reference implementation for unfriendly shapes.  Mode selection (the
+Layout: [batch, heads, seq, head_dim].  The caller-facing block sizes
+are a friendliness contract (seq divisible by them, 128-lane block_k);
+the kernel chooses its own internal tiling (up to 512-wide q blocks and
+K/V major tiles) to amortize per-grid-step overhead.  `flash_attention`
+falls back to the reference implementation for unfriendly shapes.  Mode selection (the
 relay in this image cannot compile Pallas — see PARITY.md):
 ``ELASTICDL_FLASH=auto`` (default: compiled kernel on TPU, jnp
 elsewhere), ``interpret`` (Pallas interpret mode, for tests), ``off``.
@@ -62,58 +64,112 @@ def _attention_ref(q, k, v, causal, scale):
     ).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, *, block_k,
-                  causal, scale, normalize):
-    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D];
-    # o_ref: [1, block_q, D]; l_ref/m_ref: [1, block_q]
+STATS_LANES = 128  # Mosaic wants >=(8,128) tiles; stats ride 128 lanes
+                   # broadcast, same layout as the in-tree TPU kernel.
+
+
+def _lanes_bcast(x, head_dim):
+    """[bq, 128] all-equal-lane stats -> [bq, head_dim]."""
+    if head_dim == STATS_LANES:
+        return x
+    if head_dim < STATS_LANES:
+        return x[:, :head_dim]
+    return pltpu.repeat(x, head_dim // STATS_LANES, axis=1)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, acc_scr,
+                  l_scr, m_scr, *, block_k, causal, scale, normalize):
+    # grid: (bh, num_q_blocks, num_k_blocks), K innermost.  Each grid
+    # step sees ONE [1, block_k, D] K/V tile — Pallas's automatic
+    # pipelining streams tiles HBM->VMEM overlapped with compute, so
+    # VMEM never holds the full sequence (the fori_loop-over-resident-KV
+    # variant OOMs scoped vmem at T=8k).  The running (acc, l, m) lives
+    # in VMEM scratch, persistent across the K grid dimension.
+    # Stats stay 2D [block_q, STATS_LANES] (every lane equal) so all
+    # vector ops live on full (8, 128) tiles — Mosaic rejects 1D or
+    # lane-1 output blocks.  Requires block_k == STATS_LANES so
+    # `s - m` stays lane-aligned.
     block_q = q_ref.shape[1]
-    seq_len = k_ref.shape[1]
+    block_k_major = k_ref.shape[1]
     head_dim = q_ref.shape[2]
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+        l_scr[...] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, m_scr.dtype)
+
+    # Under causal masking, major blocks strictly above the diagonal
+    # contribute nothing — skip their matmuls entirely.
+    live = (
+        ki * block_k_major <= qi * block_q + block_q - 1 if causal
+        else ki >= 0
     )
 
-    num_k = seq_len // block_k
-
-    def body(ki, carry):
-        acc, l, m = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                              # [bq, bk]
+    @pl.when(live)
+    def _major_step():
+        # Keep the operands in their storage dtype (bf16 in the mixed-
+        # precision path) and accumulate in f32 via preferred_element_type
+        # — upcasting before the dot would push the MXU onto the ~4x
+        # slower f32 path.  The scale folds into the f32 scores.
+        q = q_ref[0]                                   # [bq, D]
         if causal:
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l = l * alpha + p.sum(axis=-1)
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc = acc * alpha[:, None] + pv
-        return acc, l, m_new
 
-    acc = jnp.zeros((block_q, head_dim), jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    acc, l, m = jax.lax.fori_loop(0, num_k, body, (acc, l, m))
-    if normalize:
-        o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
-            o_ref.dtype
-        )
-    else:
-        o_ref[0] = acc.astype(o_ref.dtype)
-    l_ref[0] = l
-    m_ref[0] = m
+        # One [1, block_k_major, D] K/V tile is streamed per grid step
+        # (enough work to amortize the per-step pipeline overhead); the
+        # online-softmax update walks it in lane-width chunks.
+        @pl.loop(0, block_k_major, step=block_k, unroll=True)
+        def _inner(start):
+            k = k_ref[0, pl.ds(start, block_k), :]     # [bk, D]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                  # [bq, bk] f32
+            if causal:
+                k_pos = (
+                    ki * block_k_major + start
+                    + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1
+                    )
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_prev = m_scr[...]
+            l_prev = l_scr[...]
+            m_new = jnp.maximum(
+                m_prev, s.max(axis=-1)[:, None]
+            )                                          # [bq, LANES]
+            alpha = jnp.exp(m_prev - m_new)            # [bq, LANES]
+            p = jnp.exp(s - m_new)         # [bq, bk]; bk == STATS_LANES
+            l_scr[...] = l_prev * alpha + p.sum(axis=-1)[:, None]
+            m_scr[...] = m_new
+            pv = jax.lax.dot_general(
+                p.astype(v_ref.dtype),
+                v_ref[0, pl.ds(start, block_k), :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[...] = (
+                acc_scr[...] * _lanes_bcast(alpha, head_dim) + pv
+            )
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        acc = acc_scr[...]
+        l = l_scr[...]
+        if normalize:
+            o_ref[0] = (
+                acc / _lanes_bcast(jnp.maximum(l, 1e-30), head_dim)
+            ).astype(o_ref.dtype)
+        else:
+            o_ref[0] = acc.astype(o_ref.dtype)
+        l_ref[0] = l
+        m_ref[0] = m_scr[...]
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
@@ -124,7 +180,26 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     qr = q.reshape(bh, t, d)
     kr = k.reshape(bh, t, d)
     vr = v.reshape(bh, t, d)
-    grid = (bh, t // block_q)
+    # Work per grid step must amortize the per-step pipeline overhead:
+    # widen the q block and stream a major K/V tile (the kernel's inner
+    # loop walks it in block_k lane chunks), both capped by what
+    # divides t.  The caller's block_q/block_k are a friendliness
+    # contract (t divisible, 128 lanes) — the kernel owns its tiling.
+    block_q = block_k_major = max(
+        bs for bs in (128, 256, 512) if bs <= t and t % bs == 0
+    )
+    grid = (bh, t // block_q, t // block_k_major)
+    if causal:
+        # Dead blocks above the diagonal skip compute (pl.when in the
+        # kernel) — also skip their HBM->VMEM DMA by clamping the K/V
+        # index map to the last live block: a revisited block index is
+        # deduped by the pipeline into no copy.
+        def kv_index(i, j, ki):
+            last_live = (j * block_q + block_q - 1) // block_k_major
+            return (i, jnp.minimum(ki, last_live), 0)
+    else:
+        def kv_index(i, j, ki):
+            return (i, ki, 0)
     out_dtype = q.dtype if normalize else jnp.float32
     out, l, m = pl.pallas_call(
         functools.partial(
@@ -133,32 +208,42 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), out_dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, STATS_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, STATS_LANES), jnp.float32),
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, block_k_major, d), kv_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, block_k_major, d), kv_index,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+            pl.BlockSpec((1, block_q, STATS_LANES),
+                         lambda i, j, ki: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+            pl.BlockSpec((1, block_q, STATS_LANES),
+                         lambda i, j, ki: (i, j, 0),
                          memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, STATS_LANES), jnp.float32),
+            pltpu.VMEM((block_q, STATS_LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(qr, kr, vr)
     return (
         out.reshape(b, h, t, d),
-        l.reshape(b, h, t),
-        m.reshape(b, h, t),
+        l[..., 0].reshape(b, h, t),
+        m[..., 0].reshape(b, h, t),
     )
 
 
@@ -235,8 +320,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _friendly(t, d, block_q, block_k):
-    return not (
-        t % block_q or t % block_k or (d % 128 and d not in (64, 128, 256))
+    # block_k must equal STATS_LANES so the kernel's [bq, bk] score tile
+    # is lane-aligned with the [bq, STATS_LANES] running stats.
+    return block_k == STATS_LANES and not (
+        t % block_q or t % block_k or (d % 128 and d != 64)
     )
 
 

@@ -159,13 +159,18 @@ class CollectiveTrainer(Trainer):
         loss_fn = self._spec.loss_fn
 
         def f(p):
+            x = features
             if self._use_bf16_compute:
-                p = jax.tree_util.tree_map(
-                    lambda a: a.astype(jnp.bfloat16)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                    p,
+                # Cast params AND activations: flax promotes mixed
+                # bf16-param/f32-input matmuls back to f32, which would
+                # silently keep the MXU off the bf16 path.
+                to_bf16 = lambda a: (
+                    a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a
                 )
-            out = apply_fn(p, features, True)
+                p = jax.tree_util.tree_map(to_bf16, p)
+                x = jax.tree_util.tree_map(to_bf16, x)
+            out = apply_fn(p, x, True)
             per_example = loss_fn(out, labels).astype(jnp.float32)
             return _masked_mean(per_example, weights)
 

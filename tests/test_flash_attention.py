@@ -1,5 +1,7 @@
 """Pallas flash attention vs the jnp reference (interpret mode on CPU)."""
 
+from unittest import mock
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,11 +31,31 @@ def test_flash_matches_reference(causal):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_small_blocks():
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_multi_k_block_grid(causal):
+    # t=1024 with the kernel's 512-max tiling makes the K grid dimension
+    # 2 — exercising the scratch carry across ki, the pl.when
+    # init/finish gating, the causal dead-block skip, and the clamped
+    # kv_index DMA dedup, none of which engage when the grid is 1x1.
+    q, k, v = make_qkv(b=1, h=1, t=1024, d=64, seed=3)
+    ref = _attention_ref(q, k, v, causal, q.shape[-1] ** -0.5)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_small_blocks_fall_back():
+    # block_k != 128 cannot lane-align with the kernel's stats tiles;
+    # the wrapper must take the dense reference path (and still be
+    # numerically right).
     q, k, v = make_qkv(t=128, d=64)
     ref = _attention_ref(q, k, v, True, q.shape[-1] ** -0.5)
-    out = flash_attention(q, k, v, block_q=64, block_k=64,
-                          interpret=True)
+    with mock.patch(
+        "elasticdl_tpu.ops.flash_attention._flash",
+        side_effect=AssertionError("kernel must not run for block_k=64"),
+    ):
+        out = flash_attention(q, k, v, block_q=64, block_k=64,
+                              interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 
