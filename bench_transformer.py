@@ -1,0 +1,131 @@
+"""Flagship transformer-LM training throughput (tokens/sec/chip).
+
+The long-context path end to end on one chip: RoPE + RMSNorm decoder
+with the Pallas flash-attention kernel (ELASTICDL_FLASH=auto resolves
+to the compiled kernel on TPU), bf16 compute, f32 Adam.  The reference
+has no LM benchmark — this is the framework's own flagship number and
+the single-chip anchor for the sharded configurations that
+`__graft_entry__.dryrun_multichip` validates on a virtual mesh.
+
+Prints exactly one JSON line:
+  {"metric": "transformer_lm_train_throughput", "value": N,
+   "unit": "tokens/sec/chip", "vs_baseline": null, ...}
+(vs_baseline is null: BASELINE.json names no reference LM metric.)
+"""
+
+import json
+import os
+import sys
+import time
+
+# ~400M-param config: dim 1024, 24 layers, seq 2048 — big enough that
+# the MXU, not dispatch, is the bottleneck; small enough for one v5e.
+DIM = 1024
+LAYERS = 24
+HEADS = 16
+VOCAB = 32768
+SEQ = 2048
+BATCH = int(os.environ.get("ELASTICDL_BENCH_BATCH", "8"))
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+
+
+def run_bench(warmup=2, iters=10):
+    import jax
+
+    if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+        jax.config.update(
+            "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError:
+        pass
+    import numpy as np
+    import optax
+
+    from elasticdl_tpu.models import transformer as tfm
+
+    platform = jax.devices()[0].platform
+    dim, layers, seq, batch, iters_ = DIM, LAYERS, SEQ, BATCH, iters
+    if platform == "cpu":
+        dim, layers, seq, batch, iters_ = 256, 4, 256, 2, 2
+
+    # remat: "dots" saves matmul outputs (fewer re-FLOPs, more memory),
+    # anything else full per-layer remat.
+    remat = (
+        "dots" if os.environ.get("ELASTICDL_BENCH_REMAT") == "dots"
+        else True
+    )
+    cfg = tfm.TransformerConfig(
+        vocab_size=VOCAB, dim=dim, num_heads=HEADS, num_layers=layers,
+        max_seq_len=seq, dtype="bfloat16", remat=remat,
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+    )
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    tokens = jax.device_put(np.random.RandomState(0).randint(
+        0, VOCAB, size=(batch, seq)
+    ).astype(np.int32))
+
+    def loss_fn(p):
+        logits = tfm.forward(p, tokens, cfg, mesh=None)
+        return tfm.next_token_loss(logits, tokens).mean()
+
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = tx.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, s, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1))
+
+    compile_start = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state)
+    float(loss)  # the axon relay does not fence on block_until_ready
+    compile_secs = time.perf_counter() - compile_start
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state)
+    float(loss)
+
+    start = time.perf_counter()
+    for _ in range(iters_):
+        params, opt_state, loss = step(params, opt_state)
+    last_loss = float(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * iters_ / elapsed
+    # 6N per token fwd+bwd, plus causal attention ~ 6*L*T*dim per token
+    flops_per_token = 6.0 * n_params + 6.0 * layers * seq * dim
+    peak = 197e12 if platform in ("tpu", "axon") else None
+    mfu = (
+        round(tokens_per_sec * flops_per_token / peak, 4) if peak else None
+    )
+    return {
+        "metric": "transformer_lm_train_throughput",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "detail": {
+            "platform": platform,
+            "params_m": round(n_params / 1e6, 1),
+            "dim": dim, "layers": layers, "seq": seq, "batch": batch,
+            "ms_per_step": round(1000.0 * elapsed / iters_, 2),
+            "mfu_estimate": mfu,
+            "compile_secs": round(compile_secs, 1),
+            "last_loss": last_loss,
+            "flash": os.environ.get("ELASTICDL_FLASH", "auto"),
+        },
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_bench()))
+    sys.exit(0)
