@@ -15,10 +15,10 @@ T x T (VERDICT r1 #5; replaces the old full jnp-recompute bwd).
 normalization, returning (acc, l, m) for one KV block — the building
 block ring attention folds across ``ppermute`` hops
 (parallel/ring_attention.py).  The ring's *forward* thereby skips the
-dense per-shard score matrix; its backward currently recomputes each
-ring step densely ([T/sp x T/sp] per step — bounded by the shard, the
-same peak as the jnp fold).  A blockwise partial bwd using the saved
-stats is a later optimization.
+dense per-shard score matrix; its backward is the hand-written
+closed-form pullback ``_partial_stats_bwd`` (scans K blocks,
+recomputing each [T, block_k] score tile), so each ring step's bwd is
+O(T/sp x block_k) live, never the dense per-shard square.
 
 Layout: [batch, heads, seq, head_dim].  The caller-facing block sizes
 are a friendliness contract (seq divisible by them, 128-lane block_k);
@@ -247,6 +247,37 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     )
 
 
+def _kv_blocks(k, v, block_k):
+    """Split [B,H,Tk,D] K/V into scan-leading f32 blocks
+    [num_k, B, H, block_k, D]."""
+    b, h, tk, d = k.shape
+    num_k = tk // block_k
+    kb = jnp.moveaxis(
+        k.reshape(b, h, num_k, block_k, d), 2, 0
+    ).astype(jnp.float32)
+    vb = jnp.moveaxis(
+        v.reshape(b, h, num_k, block_k, d), 2, 0
+    ).astype(jnp.float32)
+    return num_k, kb, vb
+
+
+def _masked_block_scores(qf, kf, ki, block_k, causal, scale, k_offset,
+                         q_pos):
+    """One [B,H,T,block_k] f32 score tile, causally masked against k
+    rows offset by ``k_offset + ki*block_k``.  Returns (scores, mask)
+    with mask None when not causal — the single source of truth both
+    blockwise backwards recompute from."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", qf, kf,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        k_pos = k_offset + ki * block_k + jnp.arange(block_k)
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        return jnp.where(mask, s, NEG_INF), mask
+    return s, None
+
+
 def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k):
     """Block-recompute backward: scan over K blocks rebuilding each
     [T, block_k] probability tile from the saved (l, m) stats.  Peak
@@ -260,23 +291,14 @@ def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k):
     l_safe = jnp.maximum(l, 1e-30)
     q_pos = jnp.arange(q.shape[2])
 
-    num_k = tk // block_k
-    k_blocks = k.reshape(*k.shape[:2], num_k, block_k, k.shape[3])
-    v_blocks = v.reshape(*v.shape[:2], num_k, block_k, v.shape[3])
+    num_k, k_blocks, v_blocks = _kv_blocks(k, v, block_k)
 
     def body(carry, inputs):
         dq = carry
-        ki, kb, vb = inputs
-        kf = kb.astype(jnp.float32)
-        vf = vb.astype(jnp.float32)
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, kf,
-            preferred_element_type=jnp.float32,
-        ) * scale                                       # [B,H,T,bk]
-        if causal:
-            k_pos = ki * block_k + jnp.arange(block_k)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, NEG_INF)
+        ki, kf, vf = inputs
+        s, _ = _masked_block_scores(
+            qf, kf, ki, block_k, causal, scale, 0, q_pos
+        )                                               # [B,H,T,bk]
         p = jnp.exp(s - m[..., None]) / l_safe[..., None]
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
@@ -286,12 +308,8 @@ def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k):
         return dq, (dk, dv)
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
-    ks = jnp.arange(num_k)
     dq, (dk, dv) = jax.lax.scan(
-        body, dq0,
-        (ks,
-         jnp.moveaxis(k_blocks, 2, 0),
-         jnp.moveaxis(v_blocks, 2, 0)),
+        body, dq0, (jnp.arange(num_k), k_blocks, v_blocks)
     )
     dk = jnp.moveaxis(dk, 0, 2).reshape(k.shape)
     dv = jnp.moveaxis(dv, 0, 2).reshape(v.shape)
@@ -376,21 +394,118 @@ def _partial_ref(q, k, v, causal, scale, k_offset):
     return acc, l, m
 
 
+def _partial_stats_bwd(q, k, v, acc, l, ga, gl, gm, causal, scale,
+                       k_offset, block_k):
+    """Hand-written backward of ``(acc, l, m) = partial(q, k, v)`` that
+    walks K in blocks, recomputing each [T, block_k] score tile — live
+    memory is O(T x block_k) plus the O(T x D) grad accumulators, never
+    the dense [T, T_k] square (nor scan-vjp carry residuals).
+
+    With e_ij = exp(s_ij - m_i) the pullback of cotangents
+    (ga, gl, gm) is
+        ds_ij = e_ij (ga_i . v_j + gl_i) + (ind_ij / cnt_i) c_i,
+        c_i   = gm_i - ga_i . acc_i - gl_i l_i,
+        dv_j  = sum_i e_ij ga_i,   dq = scale ds k,   dk = scale ds^T q,
+    where ind marks the row-max positions and cnt splits ties the way
+    reduce_max's vjp does.  m is deliberately NOT taken from the saved
+    kernel stats: it is recomputed (pass 1) from the same jnp scores
+    pass 3 uses, so the ``s == m_re`` indicator compares bit-identical
+    values (kernel-vs-jnp ulp differences would silently drop the gm
+    cotangent).  Saved acc/l feed the c coefficient only.
+    """
+    b, h, tq, d = q.shape
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(tq)
+    num_k, k_blocks, v_blocks = _kv_blocks(k, v, block_k)
+    gaf = ga.astype(jnp.float32)
+
+    def scores(ki, kb):
+        return _masked_block_scores(
+            qf, kb, ki, block_k, causal, scale, k_offset, q_pos
+        )
+
+    # Pass 1: row max, recomputed so pass 3's indicator is exact.
+    def max_body(m_c, inputs):
+        ki, kb = inputs
+        s, _ = scores(ki, kb)
+        return jnp.maximum(m_c, s.max(axis=-1)), None
+
+    m_re, _ = jax.lax.scan(
+        max_body, jnp.full((b, h, tq), NEG_INF, jnp.float32),
+        (jnp.arange(num_k), k_blocks),
+    )
+
+    # Pass 2: tie count at the max (reduce_max's vjp splits ties).
+    def cnt_body(cnt, inputs):
+        ki, kb = inputs
+        s, _ = scores(ki, kb)
+        return cnt + (s == m_re[..., None]).sum(axis=-1), None
+
+    cnt, _ = jax.lax.scan(
+        cnt_body, jnp.zeros((b, h, tq), jnp.int32),
+        (jnp.arange(num_k), k_blocks),
+    )
+
+    c = (
+        gm.astype(jnp.float32)
+        - jnp.einsum("bhqd,bhqd->bhq", gaf, acc.astype(jnp.float32))
+        - gl.astype(jnp.float32) * l.astype(jnp.float32)
+    ) / jnp.maximum(cnt, 1).astype(jnp.float32)
+
+    # Pass 3: grads, one K block at a time.
+    def grad_body(dq, inputs):
+        ki, kb, vb = inputs
+        s, mask = scores(ki, kb)
+        e = jnp.exp(s - m_re[..., None])               # [B,H,T,bk]
+        ds = e * (
+            jnp.einsum("bhqd,bhkd->bhqk", gaf, vb,
+                       preferred_element_type=jnp.float32)
+            + gl.astype(jnp.float32)[..., None]
+        ) + jnp.where(s == m_re[..., None], c[..., None], 0.0)
+        if mask is not None:
+            # the dense vjp drops gradient at masked positions (the
+            # `where` in the forward); mirror it for exact parity
+            ds = jnp.where(mask, ds, 0.0)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", e, gaf)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+        dq = dq + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, kb,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        return dq, (dk, dv)
+
+    dq, (dk, dv) = jax.lax.scan(
+        grad_body, jnp.zeros((b, h, tq, d), jnp.float32),
+        (jnp.arange(num_k), k_blocks, v_blocks),
+    )
+    dk = jnp.moveaxis(dk, 0, 2).reshape(k.shape)
+    dv = jnp.moveaxis(dv, 0, 2).reshape(v.shape)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _flash_partial_fwd(q, k, v, causal, scale, block_q, block_k,
                        interpret, k_offset):
     out = _flash_partial(q, k, v, causal, scale, block_q, block_k,
                          interpret, k_offset)
-    return out, (q, k, v)
+    acc, l, _ = out
+    return out, (q, k, v, acc, l)
 
 
 def _flash_partial_bwd(causal, scale, block_q, block_k, interpret,
                        k_offset, res, g):
-    q, k, v = res
+    q, k, v, acc, l = res
+    ga, gl, gm = g
+    tk = k.shape[2]
+    if tk % block_k == 0 and tk // block_k > 1:
+        return _partial_stats_bwd(
+            q, k, v, acc, l, ga, gl, gm, causal, scale, k_offset,
+            block_k,
+        )
     _, vjp = jax.vjp(
         lambda q, k, v: _partial_ref(q, k, v, causal, scale, k_offset),
         q, k, v,
     )
-    return vjp(g)
+    return vjp((ga, gl, gm))
 
 
 _flash_partial.defvjp(_flash_partial_fwd, _flash_partial_bwd)
