@@ -79,8 +79,19 @@ class Master:
                         self.worker_manager is None
                         or self.worker_manager.all_workers_exited()
                     ):
-                        logger.info("job finished: %s",
-                                    self.task_manager.counts())
+                        counts = self.task_manager.counts()
+                        lost = sum(counts["failed"].values())
+                        if lost:
+                            # Permanently-failed tasks mean dropped data:
+                            # the job ran to the end but did not do what
+                            # was asked — surface that in the exit code
+                            # rather than reporting silent success.
+                            logger.error(
+                                "job finished with %d permanently "
+                                "failed task(s): %s", lost, counts,
+                            )
+                            return 1
+                        logger.info("job finished: %s", counts)
                         break
                 elif (
                     self.worker_manager is not None

@@ -1,0 +1,149 @@
+"""Census DNN — model_zoo/census_dnn_model parity.
+
+The reference ships the same census DNN three ways (functional /
+sequential / subclass Keras, model_zoo/census_dnn_model/
+census_functional_api.py etc.) over a shared feature-column set
+(census_feature_columns.py:18-54): 4 numeric features plus 8
+categorical features hashed into 64 buckets each and embedded at
+dim 16.  In JAX there is one way to write a pure function, so the
+three variants collapse into this module; the feature-column set is
+kept behaviorally identical and compiled with the declarative
+feature-column library (preprocessing/feature_column.py) so all 8
+categorical features share ONE offset id space and one PS-served
+embedding table.
+
+Records are dicts (column name -> raw value), the natural row shape of
+the SQL reader and of CSV-with-header sources.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.preprocessing import feature_column as fc
+from elasticdl_tpu.utils import metrics
+
+EMB_TABLE = "census_dnn_embedding"
+
+# census_feature_columns.py:18-33 — the reference's split of the census
+# schema into categorical (hash 64 -> embed 16) and numeric keys.
+CATEGORICAL_KEYS = [
+    "workclass", "education", "marital_status", "occupation",
+    "relationship", "race", "sex", "native_country",
+]
+NUMERIC_KEYS = ["age", "capital_gain", "capital_loss", "hours_per_week"]
+HASH_BUCKETS = 64
+EMBEDDING_DIM = 16
+
+
+def build_columns(use_stats=False):
+    """Numeric columns (analyzer-standardized when stats are exported)
+    plus one concatenated categorical column over all hash spaces."""
+    if use_stats:
+        numeric = [fc.NumericColumn.from_stats(k) for k in NUMERIC_KEYS]
+    else:
+        numeric = [fc.NumericColumn(k) for k in NUMERIC_KEYS]
+    cat = fc.concatenated_categorical_column(
+        [fc.CategoricalHashColumn(k, HASH_BUCKETS)
+         for k in CATEGORICAL_KEYS]
+    )
+    return numeric, cat
+
+
+def init_params(rng, num_dense, num_fields, embedding_dim,
+                hidden=(64, 32)):
+    sizes = [num_fields * embedding_dim + num_dense] + list(hidden) + [1]
+    keys = jax.random.split(rng, len(sizes))
+    params = {}
+    for i in range(len(sizes) - 1):
+        params["w%d" % i] = (
+            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i])
+        ).astype(jnp.float32)
+        params["b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    return params
+
+
+def forward(params, feats, train):
+    emb = feats["emb__" + EMB_TABLE][feats["idx__" + EMB_TABLE]]
+    x = emb.reshape(emb.shape[0], -1)
+    x = jnp.concatenate([x, feats["dense"]], axis=-1)
+    n_layers = sum(1 for k in params if k.startswith("w"))
+    for i in range(n_layers):
+        x = x @ params["w%d" % i] + params["b%d" % i]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x[:, 0]
+
+
+def model_spec(embedding_dim=EMBEDDING_DIM, hidden=(64, 32),
+               learning_rate=1e-3, use_stats=False, column_order=""):
+    """``column_order``: comma-separated column names for list-shaped
+    rows (SQL/CSV sources); empty for dict-shaped records."""
+    numeric, cat = build_columns(use_stats=use_stats)
+    order = [c for c in column_order.split(",") if c] or None
+    feed = fc.make_feed(numeric, {EMB_TABLE: cat}, column_order=order)
+    num_fields = len(CATEGORICAL_KEYS)
+
+    def init_fn(rng):
+        return init_params(rng, len(numeric), num_fields, embedding_dim,
+                           hidden)
+
+    def loss_fn(logits, labels):
+        return optax.sigmoid_binary_cross_entropy(
+            logits, labels.astype(jnp.float32)
+        )
+
+    return ModelSpec(
+        name="census_dnn",
+        init_fn=init_fn,
+        apply_fn=forward,
+        loss_fn=loss_fn,
+        optimizer=optax.adam(learning_rate),
+        feed=feed,
+        eval_metrics_fn=lambda: {
+            "auc": metrics.AUC(),
+            "accuracy": metrics.BinaryAccuracy(threshold=0.0),
+        },
+        ps_embedding_infos=[
+            {"name": EMB_TABLE, "dim": embedding_dim,
+             "initializer": "uniform"},
+        ],
+        ps_optimizer=("adam", "learning_rate=%g" % learning_rate),
+    )
+
+
+def synthetic_census_records(n=1024, seed=0):
+    """Dict-shaped census-like records with a learnable label rule."""
+    rng = np.random.RandomState(seed)
+    records = []
+    for _ in range(n):
+        age = int(rng.randint(17, 80))
+        edu = ["hs", "college", "masters", "phd", "other"][
+            rng.randint(5)]
+        hours = int(rng.randint(10, 80))
+        gain = int(rng.choice([0, 0, 0, 5000, 7000, 9000]))
+        marital = ["single", "married", "divorced"][rng.randint(3)]
+        score = (
+            (age > 35) + (edu in ("masters", "phd")) * 2
+            + (hours > 45) + (gain > 0) + (marital == "married")
+        )
+        records.append({
+            "age": age,
+            "workclass": ["private", "gov", "self", "none"][
+                rng.randint(4)],
+            "education": edu,
+            "marital_status": marital,
+            "occupation": "occ%d" % rng.randint(12),
+            "relationship": ["own", "spouse", "child"][rng.randint(3)],
+            "race": "race%d" % rng.randint(4),
+            "sex": ["m", "f"][rng.randint(2)],
+            "native_country": "c%d" % rng.randint(20),
+            "capital_gain": gain,
+            "capital_loss": int(rng.choice([0, 0, 2000])),
+            "hours_per_week": hours,
+            "label": int(score + rng.rand() * 2 > 4),
+        })
+    return records

@@ -192,20 +192,32 @@ def concatenated_categorical_column(columns):
 
 
 def make_feed(numeric_columns, id_tables, label_key="label",
-              label_dtype=np.int32):
+              label_dtype=np.int32, column_order=None):
     """Compile columns into the framework feed convention.
 
     numeric_columns: [NumericColumn] -> "dense" [B, Dn].
     id_tables: {table_name: ConcatenatedCategoricalColumn} -> "__ids__"
         entries, one per PS embedding table.
-    Records arrive as a dict of columns ({key: [B] values}) or a list of
-    per-record dicts.
+    Records arrive as a dict of columns ({key: [B] values}), a list of
+    per-record dicts, or — when ``column_order`` names the positions —
+    a list of per-record sequences (the row shape of the SQL and CSV
+    readers).
     """
 
     def feed(records):
         if isinstance(records, list):
-            keys = records[0].keys()
-            columns = {k: [r[k] for r in records] for k in keys}
+            first = records[0]
+            if isinstance(first, dict):
+                columns = {k: [r[k] for r in records] for k in first}
+            else:
+                if column_order is None:
+                    raise ValueError(
+                        "list-shaped records need column_order"
+                    )
+                columns = {
+                    k: [r[i] for r in records]
+                    for i, k in enumerate(column_order)
+                }
         else:
             columns = records
         out = {}
@@ -214,10 +226,15 @@ def make_feed(numeric_columns, id_tables, label_key="label",
                 [c.transform(columns[c.key]) for c in numeric_columns],
                 axis=1,
             )
-        out["__ids__"] = {
-            table: concat.transform(columns)
-            for table, concat in id_tables.items()
-        }
+        # Several tables may share one concat column (e.g. a wide and a
+        # deep embedding over the same id space) — transform each
+        # distinct column once per batch.
+        cache = {}
+        out["__ids__"] = {}
+        for table, concat in id_tables.items():
+            if id(concat) not in cache:
+                cache[id(concat)] = concat.transform(columns)
+            out["__ids__"][table] = cache[id(concat)]
         labels = np.asarray(columns[label_key], label_dtype)
         return out, labels
 

@@ -6,6 +6,8 @@ import numpy as np
 import pytest
 
 from elasticdl_tpu.models import (
+    census_dnn,
+    census_sqlflow,
     dcn,
     iris,
     mobilenet,
@@ -104,6 +106,64 @@ def test_wide_deep_census_through_ps():
         assert losses[-1] < losses[0]
     finally:
         stop_all(servers)
+
+
+@pytest.mark.parametrize("make_spec", [
+    lambda: census_dnn.model_spec(embedding_dim=4, hidden=(16,)),
+    lambda: census_sqlflow.model_spec("wide_and_deep",
+                                      embedding_dim=4, hidden=(16,)),
+    lambda: census_sqlflow.model_spec("dnn", embedding_dim=4,
+                                      hidden=(16,)),
+], ids=["census_dnn", "sqlflow_wide_deep", "sqlflow_dnn"])
+def test_census_models_train_through_ps(make_spec):
+    spec = make_spec()
+    client, servicers, servers = start_ps(
+        num_ps=2, opt_type="adam", opt_args="learning_rate=0.01",
+    )
+    try:
+        trainer = ParameterServerTrainer(spec, client, batch_size=32)
+        records = census_dnn.synthetic_census_records(n=256)
+        losses = []
+        for epoch in range(4):
+            for i in range(0, 256, 32):
+                feats, ys = spec.feed(records[i:i + 32])
+                loss, _ = trainer.train_minibatch(feats, ys)
+                losses.append(loss)
+        assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    finally:
+        stop_all(servers)
+
+
+def test_census_sqlflow_clause_compiles_to_disjoint_id_spaces():
+    groups = census_sqlflow.build_groups()
+    # Groups mirror the .sql's three CONCAT clauses.
+    assert sorted(groups) == ["group_1", "group_2", "group_3"]
+    records = census_dnn.synthetic_census_records(n=64)
+    columns = {k: [r[k] for r in records] for k in records[0]}
+    for concat in groups.values():
+        ids = concat.transform(columns)
+        assert ids.shape == (64, len(concat.columns))
+        assert ids.min() >= 0 and ids.max() < concat.num_buckets
+        # Per-field slices live in disjoint offset ranges.
+        for j, (col, off) in enumerate(
+            zip(concat.columns, concat.offsets)
+        ):
+            assert ids[:, j].min() >= off
+            assert ids[:, j].max() < off + col.num_buckets
+
+
+def test_census_dnn_stats_standardization(monkeypatch):
+    # Analyzer-exported stats flow into the numeric columns
+    # (use_stats=True), the reference's _ELASTICDL_* env scheme.
+    from elasticdl_tpu.preprocessing import analyzer_utils
+
+    monkeypatch.setenv("_EDL_TPU_AGE_AVG", "40")
+    monkeypatch.setenv("_EDL_TPU_AGE_STDDEV", "10")
+    assert analyzer_utils.get_mean("age") == 40.0
+    numeric, _ = census_dnn.build_columns(use_stats=True)
+    age = [c for c in numeric if c.key == "age"][0]
+    out = age.transform(["50", "30"])
+    assert np.allclose(out, [1.0, -1.0])
 
 
 @pytest.mark.slow

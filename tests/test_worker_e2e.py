@@ -73,6 +73,28 @@ def test_training_learns(dataset):
     assert accuracy > 0.5, f"model did not learn (acc={accuracy})"
 
 
+def test_permanent_task_failure_fails_the_job(dataset):
+    # A job that "finishes" after dropping tasks must exit nonzero —
+    # permanently-failed tasks are unprocessed data, not success.
+    xs, ys = dataset
+    reader = ArrayDataReader((xs, ys), records_per_shard=64)
+    master = create_master(
+        training_shards=reader.create_shards(), records_per_task=64,
+    )
+    try:
+        tm = master.task_manager
+        while True:
+            task = tm.get(worker_id=0)
+            if task is None:
+                break
+            tm.report(task.id, success=False, err_message="boom")
+        assert sum(tm.counts()["failed"].values()) > 0
+        master._poll_secs = 0.05
+        assert master.run() == 1
+    finally:
+        master.stop()
+
+
 def test_evaluation_service_runs(dataset):
     master, _ = run_job(dataset, num_epochs=2, evaluation_steps=4)
     assert master.evaluation_service.history, "no evaluation completed"
