@@ -134,13 +134,14 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
     }
 
 
-def _run_inner(batch_size, timeout_secs, fused=0):
+def _run_inner(batch_size, timeout_secs, fused=0, env=None):
     """One watchdog'd measurement subprocess; returns (result|None, reason)."""
     try:
         proc = subprocess.run(
             [sys.executable, __file__, "--inner",
              "--batch", str(batch_size), "--fused", str(fused)],
             capture_output=True, text=True, timeout=timeout_secs,
+            env={**os.environ, **(env or {})},
         )
         for line in reversed(proc.stdout.strip().splitlines()):
             line = line.strip()
@@ -160,8 +161,14 @@ def _run_with_watchdog():
     # batch 128 is the known-good configuration; retry once on timeout
     # (first attempt may have populated the compilation cache before the
     # relay hiccuped, making the retry cheap).
+    # The main attempt pins the fused-GN kernel OFF: batch-128 XLA-GN is
+    # the known-good configuration; the Pallas GroupNorm runs as its own
+    # candidate below so a kernel/compile problem can never cost the
+    # headline number.
     for attempt in range(2):
-        result, reason = _run_inner(128, timeout_secs)
+        result, reason = _run_inner(
+            128, timeout_secs, env={"ELASTICDL_FUSED_GN": "off"}
+        )
         if result is not None:
             break
         attempts.append("b128 attempt %d: %s" % (attempt + 1, reason))
@@ -174,8 +181,9 @@ def _run_with_watchdog():
             "detail": {
                 "error": "; ".join(attempts),
                 "note": "measurement failed; for context, the last "
-                        "successful run on this chip (2026-07-28, batch "
-                        "128 bf16) measured 1390.3 img/s (9.59x baseline)",
+                        "successful run on this chip (2026-07-29, batch "
+                        "128 bf16 acts+params) measured 2352.3 img/s "
+                        "(16.2x baseline)",
             },
         }
     # With a number in hand, try improvements on their own clocks; keep
@@ -186,17 +194,20 @@ def _run_with_watchdog():
         and os.environ.get("ELASTICDL_BENCH_TRY_LARGE", "1") != "0"
     ):
         attempts = (
-            ("batch256", 256, 0),
-            ("fused4", 128, 4),  # small steps-per-loop window
+            ("fusedgn", 128, 0, {"ELASTICDL_FUSED_GN": "tpu"}),
+            ("batch256", 256, 0, {"ELASTICDL_FUSED_GN": "off"}),
+            ("fused4", 128, 4,   # small steps-per-loop window
+             {"ELASTICDL_FUSED_GN": "off"}),
         )
-        for name, batch, fused in attempts:
+        for name, batch, fused, env in attempts:
             better, reason = _run_inner(
-                batch, min(timeout_secs, 600), fused=fused
+                batch, min(timeout_secs, 600), fused=fused, env=env,
             )
             if better is not None and (
                 (better["value"] or 0) > result["value"]
             ):
                 better["detail"]["previous_value"] = result["value"]
+                better["detail"]["config"] = name
                 result = better
             elif better is None:
                 result["detail"]["%s_attempt" % name] = reason

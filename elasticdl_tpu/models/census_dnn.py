@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.models import mlp
 from elasticdl_tpu.models.spec import ModelSpec
 from elasticdl_tpu.preprocessing import feature_column as fc
 from elasticdl_tpu.utils import metrics
@@ -55,27 +56,14 @@ def build_columns(use_stats=False):
 def init_params(rng, num_dense, num_fields, embedding_dim,
                 hidden=(64, 32)):
     sizes = [num_fields * embedding_dim + num_dense] + list(hidden) + [1]
-    keys = jax.random.split(rng, len(sizes))
-    params = {}
-    for i in range(len(sizes) - 1):
-        params["w%d" % i] = (
-            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
-            * np.sqrt(2.0 / sizes[i])
-        ).astype(jnp.float32)
-        params["b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
-    return params
+    return mlp.mlp_init(rng, sizes)
 
 
 def forward(params, feats, train):
     emb = feats["emb__" + EMB_TABLE][feats["idx__" + EMB_TABLE]]
     x = emb.reshape(emb.shape[0], -1)
     x = jnp.concatenate([x, feats["dense"]], axis=-1)
-    n_layers = sum(1 for k in params if k.startswith("w"))
-    for i in range(n_layers):
-        x = x @ params["w%d" % i] + params["b%d" % i]
-        if i < n_layers - 1:
-            x = jax.nn.relu(x)
-    return x[:, 0]
+    return mlp.mlp_apply(params, x)[:, 0]
 
 
 def model_spec(embedding_dim=EMBEDDING_DIM, hidden=(64, 32),

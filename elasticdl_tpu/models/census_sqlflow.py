@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.models import mlp
 from elasticdl_tpu.models.spec import ModelSpec
 from elasticdl_tpu.preprocessing import feature_column as fc
 from elasticdl_tpu.utils import metrics
@@ -107,15 +108,8 @@ def _table(group, role):
 def init_params(rng, fields_per_group, embedding_dim,
                 hidden=(64, 32)):
     d0 = sum(fields_per_group) * embedding_dim
-    sizes = [d0] + list(hidden) + [1]
-    keys = jax.random.split(rng, len(sizes))
-    params = {"bias": jnp.zeros((1,), jnp.float32)}
-    for i in range(len(sizes) - 1):
-        params["w%d" % i] = (
-            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
-            * np.sqrt(2.0 / sizes[i])
-        ).astype(jnp.float32)
-        params["b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    params = mlp.mlp_init(rng, [d0] + list(hidden) + [1])
+    params["bias"] = jnp.zeros((1,), jnp.float32)
     return params
 
 
@@ -127,12 +121,7 @@ def make_forward(group_names, wide_groups):
             rows = feats["emb__" + t][feats["idx__" + t]]
             deep_parts.append(rows.reshape(rows.shape[0], -1))
         x = jnp.concatenate(deep_parts, axis=-1)
-        n_layers = sum(1 for k in params if k.startswith("w"))
-        for i in range(n_layers):
-            x = x @ params["w%d" % i] + params["b%d" % i]
-            if i < n_layers - 1:
-                x = jax.nn.relu(x)
-        logit = x[:, 0] + params["bias"][0]
+        logit = mlp.mlp_apply(params, x)[:, 0] + params["bias"][0]
         for g in wide_groups:
             t = _table(g, "wide")
             logit = logit + feats["emb__" + t][feats["idx__" + t]][

@@ -1,0 +1,37 @@
+"""Shared He-init MLP used by the tabular/CTR zoo models.
+
+One implementation of the ``w%d``/``b%d`` dense stack that census_dnn,
+census_sqlflow, and wide_deep previously each re-implemented (the param
+naming is part of those models' checkpoint format, so it is preserved
+here).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(rng, sizes):
+    """He-initialized params {"w0","b0",...} for the layer widths
+    ``sizes`` ([in, hidden..., out])."""
+    keys = jax.random.split(rng, max(2, len(sizes) - 1))
+    params = {}
+    for i in range(len(sizes) - 1):
+        params["w%d" % i] = (
+            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i])
+        ).astype(jnp.float32)
+        params["b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    return params
+
+
+def mlp_apply(params, x):
+    """Dense stack with ReLU between layers (linear final layer).
+    Ignores params outside the w%d/b%d convention, so models may mix
+    extra keys (e.g. a global "bias") into the same dict."""
+    n_layers = sum(1 for k in params if k.startswith("w"))
+    for i in range(n_layers):
+        x = x @ params["w%d" % i] + params["b%d" % i]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x

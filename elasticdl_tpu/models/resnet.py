@@ -15,7 +15,25 @@ import numpy as np
 import optax
 
 from elasticdl_tpu.models.spec import ModelSpec
+from elasticdl_tpu.ops.group_norm import fused_group_norm
 from elasticdl_tpu.utils import metrics
+
+
+class GroupNorm(nn.Module):
+    """GroupNorm(+ReLU) on the fused Pallas kernel (ops/group_norm.py);
+    param names/shapes match flax.linen.GroupNorm so checkpoints are
+    interchangeable with the un-fused module."""
+
+    num_groups: int
+    relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        channels = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (channels,))
+        bias = self.param("bias", nn.initializers.zeros, (channels,))
+        return fused_group_norm(x, scale, bias, self.num_groups,
+                                relu=self.relu)
 
 
 class Bottleneck(nn.Module):
@@ -25,21 +43,21 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        def gn(channels):
+        def gn(channels, relu=False):
             # group count that always divides the channel count
-            return nn.GroupNorm(num_groups=int(np.gcd(self.groups,
-                                                      channels)))
+            return GroupNorm(
+                num_groups=int(np.gcd(self.groups, channels)),
+                relu=relu,
+            )
 
         residual = x
         y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
-        y = gn(self.features)(y)
-        y = nn.relu(y)
+        y = gn(self.features, relu=True)(y)
         y = nn.Conv(
             self.features, (3, 3), strides=(self.strides, self.strides),
             padding="SAME", use_bias=False,
         )(y)
-        y = gn(self.features)(y)
-        y = nn.relu(y)
+        y = gn(self.features, relu=True)(y)
         out_features = self.features * 4
         y = nn.Conv(out_features, (1, 1), use_bias=False)(y)
         y = gn(out_features)(y)
@@ -66,8 +84,8 @@ class ResNet(nn.Module):
         else:
             x = nn.Conv(self.width, (7, 7), strides=(2, 2),
                         padding=[(3, 3), (3, 3)], use_bias=False)(x)
-        x = nn.GroupNorm(num_groups=int(np.gcd(32, self.width)))(x)
-        x = nn.relu(x)
+        x = GroupNorm(num_groups=int(np.gcd(32, self.width)),
+                      relu=True)(x)
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, blocks in enumerate(self.stage_sizes):

@@ -54,8 +54,15 @@ class TransformerConfig:
     # more FLOPs.  Required to fit training-scale configs (24 layers x
     # T=2048 saves ~20 GB of activations un-remat'ed on one chip).
     # True = save nothing; "dots" = save matmul outputs and recompute
-    # only the cheap elementwise work (more memory, fewer re-FLOPs).
+    # only the cheap elementwise work (more memory, fewer re-FLOPs);
+    # "attn" = save only the attention outputs (B*T*dim per layer), so
+    # the recompute skips flash attention but everything else remats.
     remat: bool | str = False
+    # Sequence-parallel strategy over the ``sp`` mesh axis: "ring"
+    # (ppermute K/V streaming, parallel/ring_attention.py) or "ulysses"
+    # (all-to-all head/sequence re-sharding, parallel/ulysses.py;
+    # requires (heads/tp) % sp == 0).
+    attention_impl: str = "ring"
 
     @property
     def head_dim(self):
@@ -286,9 +293,25 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
         from elasticdl_tpu.parallel.ring_attention import attention_local
 
         attn = attention_local(q, k, v, causal=True, mode=attention_mode)
-    else:
+    elif cfg.attention_impl == "ulysses":
+        from elasticdl_tpu.parallel.ulysses import ulysses_attention
+
+        attn = ulysses_attention(q, k, v, mesh, causal=True)
+    elif cfg.attention_impl == "ring":
         attn = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        raise ValueError(
+            "unknown attention_impl %r (want 'ring' or 'ulysses')"
+            % (cfg.attention_impl,)
+        )
     attn = attn.reshape(B, T, H * D)
+    # Named so remat="attn" can save exactly this tensor: the layer
+    # recompute in the backward then skips re-running flash attention
+    # (the score-matmul ~40% of layer FLOPs at T=2048) while saving
+    # only B*T*dim per layer instead of every intermediate.
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn = checkpoint_name(attn, "attn_out")
     x = x + _constrain(
         attn @ w["wo"].astype(compute_dtype), mesh, act_spec
     )
@@ -338,6 +361,13 @@ def forward(params, tokens, cfg, mesh=None, return_aux=False):
         layer = jax.checkpoint(
             layer,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif cfg.remat == "attn":
+        layer = jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            ),
         )
     elif cfg.remat:
         layer = jax.checkpoint(layer)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.models import mlp
 from elasticdl_tpu.models.spec import ModelSpec
 from elasticdl_tpu.preprocessing.layers import (
     ConcatenateWithOffset,
@@ -81,14 +82,8 @@ def build_feed():
 
 def init_params(rng, num_fields, embedding_dim, hidden=(64, 32)):
     sizes = [num_fields * embedding_dim] + list(hidden) + [1]
-    keys = jax.random.split(rng, len(sizes))
-    params = {"bias": jnp.zeros((1,), jnp.float32)}
-    for i in range(len(sizes) - 1):
-        params["w%d" % i] = (
-            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
-            * np.sqrt(2.0 / sizes[i])
-        ).astype(jnp.float32)
-        params["b%d" % i] = jnp.zeros((sizes[i + 1],), jnp.float32)
+    params = mlp.mlp_init(rng, sizes)
+    params["bias"] = jnp.zeros((1,), jnp.float32)
     return params
 
 
@@ -98,12 +93,7 @@ def forward(params, feats, train):
         ..., 0
     ].sum(axis=1)
     x = deep_v.reshape(deep_v.shape[0], -1)
-    n_layers = sum(1 for k in params if k.startswith("w"))
-    for i in range(n_layers):
-        x = x @ params["w%d" % i] + params["b%d" % i]
-        if i < n_layers - 1:
-            x = jax.nn.relu(x)
-    return wide + x[:, 0] + params["bias"][0]
+    return wide + mlp.mlp_apply(params, x)[:, 0] + params["bias"][0]
 
 
 def model_spec(embedding_dim=8, hidden=(64, 32), learning_rate=1e-3):

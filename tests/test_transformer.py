@@ -1,6 +1,8 @@
 """Flagship transformer: forward parity across parallelism layouts, and a
 full 4-axis (dp/pp/tp/sp) train step on the virtual 8-device mesh."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -48,6 +50,42 @@ def test_sharded_forward_matches_single_device(params, axes):
         lambda p, t: tfm.forward(p, t, CFG, mesh=mesh)
     )(sharded, tokens)
     np.testing.assert_allclose(ref, np.asarray(out), rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.parametrize("remat", [True, "attn", "dots"])
+def test_remat_policies_preserve_gradients(params, remat):
+    tokens = make_tokens(b=2, t=16)
+    cfg_r = dataclasses.replace(CFG, remat=remat)
+
+    def loss(cfg):
+        def f(p):
+            logits = tfm.forward(p, tokens, cfg)
+            return tfm.next_token_loss(logits, tokens).mean()
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(CFG))(params)
+    l1, g1 = jax.value_and_grad(loss(cfg_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-5),
+        g0, g1,
+    )
+
+
+def test_ulysses_forward_matches_single_device(params):
+    # Same sharded-parity check with the all-to-all sequence-parallel
+    # path selected (attention_impl="ulysses", parallel/ulysses.py).
+    tokens = make_tokens()
+    cfg = dataclasses.replace(CFG, attention_impl="ulysses")
+    ref = np.asarray(tfm.forward(params, tokens, cfg))
+    mesh = build_mesh(dp=2, tp=2, sp=2)
+    sharded = tfm.shard_params(params, mesh, cfg)
+    out = jax.jit(
+        lambda p, t: tfm.forward(p, t, cfg, mesh=mesh)
+    )(sharded, tokens)
+    np.testing.assert_allclose(ref, np.asarray(out), rtol=5e-4,
+                               atol=5e-4)
 
 
 MOE_CFG = tfm.TransformerConfig(
