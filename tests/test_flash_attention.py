@@ -107,11 +107,52 @@ def test_partial_matches_reference_stats():
                                    rtol=2e-5, atol=2e-5)
 
 
-def test_blockwise_bwd_is_used_and_matches(monkeypatch):
-    """The bwd pass must go through the block-recompute path (not a full
-    T x T jnp recompute) and still match reference gradients."""
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [256, 384])
+def test_pallas_bwd_matches_reference(causal, t, monkeypatch):
+    """The default backward is the Pallas kernel pair (dq; dk/dv) —
+    it must be the path taken and match reference gradients.  t=384
+    forces tile=128 -> a 3x3 block grid, exercising the cross-step
+    scratch accumulation and the causal-clamped index maps (t=256 is
+    a single-block grid where init/finish coincide)."""
     import elasticdl_tpu.ops.flash_attention as fa
 
+    called = {}
+    orig = fa._pallas_bwd
+
+    def spy(*args, **kwargs):
+        called["yes"] = True
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fa, "_pallas_bwd", spy)
+    q, k, v = make_qkv(t=t)
+
+    def loss_flash(q, k, v):
+        return (
+            fa.flash_attention(q, k, v, causal=causal,
+                               interpret=True) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (
+            fa._attention_ref(q, k, v, causal,
+                              q.shape[-1] ** -0.5) ** 2
+        ).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert called.get("yes"), "pallas bwd was not invoked"
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_xla_bwd_escape_hatch_matches(monkeypatch):
+    """ELASTICDL_FLASH_BWD=xla routes through the block-recompute scan
+    (the fallback while a relay can't compile the bwd kernels)."""
+    import elasticdl_tpu.ops.flash_attention as fa
+
+    monkeypatch.setenv("ELASTICDL_FLASH_BWD", "xla")
     called = {}
     orig = fa._blockwise_bwd
 
@@ -132,7 +173,7 @@ def test_blockwise_bwd_is_used_and_matches(monkeypatch):
 
     g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    assert called.get("yes"), "block-recompute bwd was not invoked"
+    assert called.get("yes"), "xla block-recompute bwd was not invoked"
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-3)

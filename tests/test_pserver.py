@@ -79,6 +79,32 @@ def test_async_push_gradients_applies_immediately():
         stop_all(servers)
 
 
+def test_async_staleness_modulated_lr():
+    # Async SGD with lr_staleness_modulation: a gradient computed
+    # against version v applied at version V steps with lr/(V-v)
+    # (reference go/pkg/ps/server.go staleness lr, python
+    # servicer.py:124-167 semantics).
+    client, servicers, servers = start_ps(
+        num_ps=1, use_async=True, lr_staleness_modulation=True,
+    )
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        # Two fresh pushes raise the version to 2.
+        client.push_gradients({"w": np.zeros(4, np.float32)}, version=0)
+        client.push_gradients({"w": np.zeros(4, np.float32)}, version=1)
+        # Now a stale push: grad_version=0 vs version=2 -> staleness 2,
+        # effective lr = 0.1 / 2.
+        client.push_gradients(
+            {"w": np.full(4, 1.0, np.float32)}, version=0
+        )
+        _, _, pulled = client.pull_dense_parameters(-1)
+        np.testing.assert_allclose(
+            pulled["w"], 1 - 0.1 / 2, rtol=1e-6
+        )
+    finally:
+        stop_all(servers)
+
+
 def test_sync_waits_and_averages():
     client, servicers, servers = start_ps(
         num_ps=1, use_async=False, grads_to_wait=2
