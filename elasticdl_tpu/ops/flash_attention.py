@@ -52,14 +52,18 @@ def flash_mode():
     return mode
 
 
-def _attention_ref(q, k, v, causal, scale):
-    """jnp reference in the same [B, H, T, D] layout."""
+def _attention_ref(q, k, v, causal, scale, window=0):
+    """jnp reference in the same [B, H, T, D] layout.  ``window`` > 0
+    limits causal attention to the last ``window`` positions."""
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
         tq, tk = q.shape[2], k.shape[2]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        diff = jnp.arange(tq)[:, None] - jnp.arange(tk)[None, :]
+        mask = diff >= 0
+        if window:
+            mask &= diff < window
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
@@ -81,7 +85,8 @@ def _lanes_bcast(x, head_dim):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, acc_scr,
-                  l_scr, m_scr, *, block_k, causal, scale, normalize):
+                  l_scr, m_scr, *, block_k, causal, scale, normalize,
+                  window=0):
     # grid: (bh, num_q_blocks, num_k_blocks), K innermost.  Each grid
     # step sees ONE [1, block_k, D] K/V tile — Pallas's automatic
     # pipelining streams tiles HBM->VMEM overlapped with compute, so
@@ -106,11 +111,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, acc_scr,
         m_scr[...] = jnp.full(m_scr.shape, NEG_INF, m_scr.dtype)
 
     # Under causal masking, major blocks strictly above the diagonal
-    # contribute nothing — skip their matmuls entirely.
+    # contribute nothing — skip their matmuls entirely.  A sliding
+    # window additionally kills blocks entirely below the band.
     live = (
         ki * block_k_major <= qi * block_q + block_q - 1 if causal
         else ki >= 0
     )
+    if causal and window:
+        live &= (
+            ki * block_k_major + block_k_major - 1
+            >= qi * block_q - window + 1
+        )
 
     @pl.when(live)
     def _major_step():
@@ -141,7 +152,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, acc_scr,
                         jnp.int32, (block_q, block_k), 1
                     )
                 )
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+                keep = q_pos >= k_pos
+                if window:
+                    keep &= q_pos - k_pos < window
+                s = jnp.where(keep, s, NEG_INF)
             m_prev = m_scr[...]
             l_prev = l_scr[...]
             m_new = jnp.maximum(
@@ -176,7 +190,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_ref, acc_scr,
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                   normalize=True):
+                   normalize=True, window=0):
     """Returns (out, l, m); out is normalized iff ``normalize``."""
     b, h, t, d = q.shape
     bh = b * h
@@ -197,7 +211,13 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         # deduped by the pipeline into no copy.
         def kv_index(i, j, ki):
             last_live = (j * block_q + block_q - 1) // block_k_major
-            return (i, jnp.minimum(ki, last_live), 0)
+            if window:
+                first_live = jnp.maximum(
+                    0, (j * block_q - window + 1) // block_k_major
+                )
+            else:
+                first_live = 0
+            return (i, jnp.clip(ki, first_live, last_live), 0)
     else:
         def kv_index(i, j, ki):
             return (i, ki, 0)
@@ -205,7 +225,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     out, l, m = pl.pallas_call(
         functools.partial(
             _flash_kernel, block_k=block_k, causal=causal, scale=scale,
-            normalize=normalize,
+            normalize=normalize, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), out_dtype),
@@ -263,7 +283,7 @@ def _kv_blocks(k, v, block_k):
 
 
 def _masked_block_scores(qf, kf, ki, block_k, causal, scale, k_offset,
-                         q_pos):
+                         q_pos, window=0):
     """One [B,H,T,block_k] f32 score tile, causally masked against k
     rows offset by ``k_offset + ki*block_k``.  Returns (scores, mask)
     with mask None when not causal — the single source of truth both
@@ -274,12 +294,17 @@ def _masked_block_scores(qf, kf, ki, block_k, causal, scale, k_offset,
     ) * scale
     if causal:
         k_pos = k_offset + ki * block_k + jnp.arange(block_k)
-        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        diff = q_pos[:, None] - k_pos[None, :]
+        mask = diff >= 0
+        if window:
+            mask &= diff < window
+        mask = mask[None, None]
         return jnp.where(mask, s, NEG_INF), mask
     return s, None
 
 
-def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k):
+def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k,
+                   window=0):
     """Block-recompute backward: scan over K blocks rebuilding each
     [T, block_k] probability tile from the saved (l, m) stats.  Peak
     live memory O(B·H·T·block_k), never the T x T matrix."""
@@ -298,7 +323,7 @@ def _blockwise_bwd(q, k, v, out, l, m, g, causal, scale, block_k):
         dq = carry
         ki, kf, vf = inputs
         s, _ = _masked_block_scores(
-            qf, kf, ki, block_k, causal, scale, 0, q_pos
+            qf, kf, ki, block_k, causal, scale, 0, q_pos, window=window
         )                                               # [B,H,T,bk]
         p = jnp.exp(s - m[..., None]) / l_safe[..., None]
         dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
@@ -327,7 +352,8 @@ def _major_tile(t):
 
 
 def _bwd_dq_kernel(q_ref, o_ref, do_ref, k_ref, v_ref, l_ref, m_ref,
-                   dq_ref, dq_scr, *, block_k, causal, scale):
+                   dq_ref, dq_scr, *, block_k, causal, scale,
+                   window=0):
     """dq = sum_j ds_ij k_j.  Grid (bh, NQ, NK), K innermost: the q/o/dO
     tiles and stats stay resident while K/V tiles stream through VMEM;
     the [bq, block_k] probability/ds tiles never exist outside VMEM."""
@@ -345,6 +371,11 @@ def _bwd_dq_kernel(q_ref, o_ref, do_ref, k_ref, v_ref, l_ref, m_ref,
         ki * block_k_major <= qi * block_q + block_q - 1 if causal
         else ki >= 0
     )
+    if causal and window:
+        live &= (
+            ki * block_k_major + block_k_major - 1
+            >= qi * block_q - window + 1
+        )
 
     @pl.when(live)
     def _step():
@@ -375,7 +406,10 @@ def _bwd_dq_kernel(q_ref, o_ref, do_ref, k_ref, v_ref, l_ref, m_ref,
                         jnp.int32, (block_q, block_k), 1
                     )
                 )
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+                keep = q_pos >= k_pos
+                if window:
+                    keep &= q_pos - k_pos < window
+                s = jnp.where(keep, s, NEG_INF)
             p = jnp.exp(s - m) / l                     # normalized
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -394,7 +428,7 @@ def _bwd_dq_kernel(q_ref, o_ref, do_ref, k_ref, v_ref, l_ref, m_ref,
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, o_ref, do_ref, l_ref, m_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, block_q, causal, scale):
+                    *, block_q, causal, scale, window=0):
     """dk_j = sum_i ds_ij^T q_i, dv_j = sum_i p_ij^T dO_i.  Grid
     (bh, NK, NQ), Q innermost: the K/V tiles and accumulators stay
     resident while q/o/dO tiles (and their stats) stream through."""
@@ -413,6 +447,11 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, o_ref, do_ref, l_ref, m_ref,
         qi * block_q_major + block_q_major - 1 >= kj * block_k_major
         if causal else qi >= 0
     )
+    if causal and window:
+        live &= (
+            qi * block_q_major
+            <= kj * block_k_major + block_k_major - 1 + window - 1
+        )
 
     @pl.when(live)
     def _step():
@@ -443,7 +482,10 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, o_ref, do_ref, l_ref, m_ref,
                         jnp.int32, (block_q, block_k_major), 0
                     )
                 )
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+                keep = q_pos >= k_pos
+                if window:
+                    keep &= q_pos - k_pos < window
+                s = jnp.where(keep, s, NEG_INF)
             p = jnp.exp(s - m) / l                     # [qc, bkM]
             pb = p.astype(do_ref.dtype)
             dv_scr[...] += jax.lax.dot_general(
@@ -469,7 +511,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, o_ref, do_ref, l_ref, m_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret):
+def _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret,
+                window=0):
     """Pallas backward: dq in one pass (K streamed), dk/dv in another
     (Q streamed).  Same FLOPs as the XLA block-recompute path but the
     probability/ds tiles live only in VMEM — no [B,H,T,block] HBM
@@ -497,12 +540,17 @@ def _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret):
                            memory_space=pltpu.VMEM)
     if causal:
         # Dead blocks skip compute; clamp the streamed-side index map so
-        # their HBM->VMEM copies dedupe away too.
+        # their HBM->VMEM copies dedupe away too.  (Equal fwd tile
+        # sizes, so tile index arithmetic is 1:1.)
+        win_tiles = (window + tile - 2) // tile if window else 0
+
         def kv_index(i, j, kk):
-            return (i, jnp.minimum(kk, j), 0)
+            lo = jnp.maximum(0, j - win_tiles) if window else 0
+            return (i, jnp.clip(kk, lo, j), 0)
 
         def q_index(i, j, kk):
-            return (i, jnp.maximum(kk, j), 0)
+            hi = j + win_tiles if window else num - 1
+            return (i, jnp.clip(kk, j, hi), 0)
     else:
         def kv_index(i, j, kk):
             return (i, kk, 0)
@@ -513,7 +561,7 @@ def _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret):
                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=128, causal=causal,
-                          scale=scale),
+                          scale=scale, window=window),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         grid=(bh, num, num),
         in_specs=[qo_spec, qo_spec, qo_spec, kv_spec, kv_spec,
@@ -534,7 +582,7 @@ def _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret):
                             memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=128, causal=causal,
-                          scale=scale),
+                          scale=scale, window=window),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), k.dtype),
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
@@ -559,26 +607,30 @@ def _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           window=0):
     out, _, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+                               interpret, window=window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window=0):
     out, l, m = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+                               interpret, window=window)
     return out, (q, k, v, out, l, m)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
+               g):
     q, k, v, out, l, m = res
     if os.environ.get("ELASTICDL_FLASH_BWD", "pallas") == "xla":
         # Escape hatch: the XLA block-recompute backward.
         return _blockwise_bwd(q, k, v, out, l, m, g, causal, scale,
-                              block_k)
-    return _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret)
+                              block_k, window=window)
+    return _pallas_bwd(q, k, v, out, l, m, g, causal, scale, interpret,
+                       window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -593,16 +645,21 @@ def _friendly(t, d, block_q, block_k):
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
-                    block_k=128, interpret=False):
-    """q, k, v: [batch, heads, seq, head_dim]."""
+                    block_k=128, interpret=False, window=0):
+    """q, k, v: [batch, heads, seq, head_dim].  ``window`` > 0 limits
+    causal attention to the last ``window`` positions (O(T·W) compute:
+    blocks outside the band skip both matmuls and DMA)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     t = q.shape[2]
     d = q.shape[3]
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     if not _friendly(t, d, block_q, block_k):
-        return _attention_ref(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+        return _attention_ref(q, k, v, causal, scale, window=window)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+                  window)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))

@@ -109,12 +109,14 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale, mode="off"):
     return o.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def attention_local(q, k, v, causal=True, scale=None, mode=None):
+def attention_local(q, k, v, causal=True, scale=None, mode=None,
+                    window=0):
     """Single-device attention in ring layout [B, T, H, D].
 
-    Routes to the Pallas flash kernel (with its block-recompute bwd)
-    when the platform allows — this is the sp=1 hot path the flagship
-    transformer hits; the jnp reference covers everything else."""
+    Routes to the Pallas flash kernel (with its Pallas bwd) when the
+    platform allows — this is the sp=1 hot path the flagship
+    transformer hits; the jnp reference covers everything else.
+    ``window`` > 0 = sliding-window causal attention."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     mode = flash_mode() if mode is None else mode
     if mode in ("tpu", "interpret"):
@@ -123,7 +125,7 @@ def attention_local(q, k, v, causal=True, scale=None, mode=None):
         o = flash_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
-            interpret=(mode == "interpret"),
+            interpret=(mode == "interpret"), window=window,
         )
         return o.transpose(0, 2, 1, 3).astype(q.dtype)
     s = jnp.einsum(
@@ -131,7 +133,10 @@ def attention_local(q, k, v, causal=True, scale=None, mode=None):
     ) * scale
     if causal:
         tq, tk = q.shape[1], k.shape[1]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        diff = jnp.arange(tq)[:, None] - jnp.arange(tk)[None, :]
+        mask = diff >= 0
+        if window:
+            mask &= diff < window
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum(

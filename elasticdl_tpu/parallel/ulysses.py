@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.parallel.ring_attention import attention_local
 
 
-def _ulysses_local(q, k, v, sp_axis, causal, scale, mode):
+def _ulysses_local(q, k, v, sp_axis, causal, scale, mode, window):
     """Per-device body: shards are [B, T/sp, H_local, D]."""
 
     def a2a_to_heads(x):
@@ -55,13 +55,14 @@ def _ulysses_local(q, k, v, sp_axis, causal, scale, mode):
         )
 
     q, k, v = a2a_to_heads(q), a2a_to_heads(k), a2a_to_heads(v)
-    out = attention_local(q, k, v, causal=causal, scale=scale, mode=mode)
+    out = attention_local(q, k, v, causal=causal, scale=scale,
+                          mode=mode, window=window)
     return a2a_to_seq(out)
 
 
 def ulysses_attention(q, k, v, mesh, causal=True, scale=None,
                       dp_axis="dp", sp_axis="sp", tp_axis="tp",
-                      mode=None):
+                      mode=None, window=0):
     """All-to-all sequence-parallel attention over mesh axis ``sp``.
 
     q, k, v: [batch, seq, heads, head_dim] global (or sharded) arrays.
