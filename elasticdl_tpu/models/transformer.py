@@ -63,6 +63,11 @@ class TransformerConfig:
     # (all-to-all head/sequence re-sharding, parallel/ulysses.py;
     # requires (heads/tp) % sp == 0).
     attention_impl: str = "ring"
+    # Sliding-window causal attention: 0 = full causal; W > 0 keeps only
+    # the last W positions (O(T·W) attention compute — out-of-band
+    # blocks skip matmuls and DMA in the flash kernel, and whole ring
+    # steps skip when the shard lies past the band).
+    window: int = 0
 
     @property
     def head_dim(self):
@@ -292,13 +297,16 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
     if mesh is None and attention_mode is not None:
         from elasticdl_tpu.parallel.ring_attention import attention_local
 
-        attn = attention_local(q, k, v, causal=True, mode=attention_mode)
+        attn = attention_local(q, k, v, causal=True, mode=attention_mode,
+                               window=cfg.window)
     elif cfg.attention_impl == "ulysses":
         from elasticdl_tpu.parallel.ulysses import ulysses_attention
 
-        attn = ulysses_attention(q, k, v, mesh, causal=True)
+        attn = ulysses_attention(q, k, v, mesh, causal=True,
+                                 window=cfg.window)
     elif cfg.attention_impl == "ring":
-        attn = ring_attention(q, k, v, mesh, causal=True)
+        attn = ring_attention(q, k, v, mesh, causal=True,
+                              window=cfg.window)
     else:
         raise ValueError(
             "unknown attention_impl %r (want 'ring' or 'ulysses')"

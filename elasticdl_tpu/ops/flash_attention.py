@@ -636,6 +636,13 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _check_window(window, causal):
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
+    if window < 0:
+        raise ValueError("window must be >= 0, got %d" % window)
+
+
 def _friendly(t, d, block_q, block_k):
     # block_k must equal STATS_LANES so the kernel's [bq, bk] score tile
     # is lane-aligned with the [bq, STATS_LANES] running stats.
@@ -650,8 +657,7 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
     causal attention to the last ``window`` positions (O(T·W) compute:
     blocks outside the band skip both matmuls and DMA)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if window and not causal:
-        raise ValueError("sliding window requires causal attention")
+    _check_window(window, causal)
     t = q.shape[2]
     d = q.shape[3]
     block_q = min(block_q, t)
@@ -662,31 +668,36 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
                   window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_partial(q, k, v, causal, scale, block_q, block_k, interpret,
-                   k_offset):
+                   k_offset, window):
     # causal here means the diagonal (k_offset == 0) block, where the
     # kernel's absolute-position mask equals the local mask.
     out, l, m = _flash_forward(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, interpret=interpret, normalize=False,
+        window=window,
     )
     return out, l, m
 
 
-def _partial_ref(q, k, v, causal, scale, k_offset):
+def _partial_ref(q, k, v, causal, scale, k_offset, window=0):
     """Unnormalized block attention in jnp (ring-fold fallback and the
     recompute target of the partial bwd).  Positions: q rows are local,
-    k rows offset by ``k_offset`` (ring rotation)."""
+    k rows offset by ``k_offset`` (ring rotation); ``window`` > 0 keeps
+    only q_pos - k_pos in [0, window)."""
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     ) * scale
     if causal:
         tq, tk = q.shape[2], k.shape[2]
-        mask = (
-            jnp.arange(tq)[:, None] >= (k_offset + jnp.arange(tk))[None, :]
+        diff = (
+            jnp.arange(tq)[:, None] - (k_offset + jnp.arange(tk))[None, :]
         )
+        mask = diff >= 0
+        if window:
+            mask &= diff < window
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = s.max(axis=-1)
     p = jnp.exp(s - m[..., None])
@@ -698,8 +709,63 @@ def _partial_ref(q, k, v, causal, scale, k_offset):
     return acc, l, m
 
 
+def _partial_banded(q, k, v, scale, k_offset, window, block_k=128):
+    """Causal banded partial for a TRACED ``k_offset`` (the ring's
+    window-straddling block, where the offset depends on the device
+    rank).  Scans K blocks with the online-softmax fold and
+    ``jax.checkpoint`` on the per-block math, so live memory is
+    O(T·block_k) in both directions — never the dense [T, T_k] square
+    the jnp reference would materialize.  Falls back to ``_partial_ref``
+    when T_k doesn't divide into blocks."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if tk % block_k or tk // block_k <= 1:
+        return _partial_ref(q, k, v, True, scale, k_offset, window=window)
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(tq)
+    num_k, k_blocks, v_blocks = _kv_blocks(k, v, block_k)
+
+    @jax.checkpoint
+    def block(ki, kb, vb):
+        s, _ = _masked_block_scores(
+            qf, kb, ki, block_k, True, scale, k_offset, q_pos,
+            window=window,
+        )
+        m_i = s.max(axis=-1)
+        p = jnp.exp(s - m_i[..., None])
+        l_i = p.sum(axis=-1)
+        acc_i = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb,
+            preferred_element_type=jnp.float32,
+        )
+        return acc_i, l_i, m_i
+
+    def body(carry, inputs):
+        o, l, m = carry
+        ki, kb, vb = inputs
+        acc_i, l_i, m_i = block(ki, kb, vb)
+        m_new = jnp.maximum(m, m_i)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_i - m_new)
+        return (
+            o * alpha[..., None] + acc_i * beta[..., None],
+            l * alpha + l_i * beta,
+            m_new,
+        ), None
+
+    init = (
+        jnp.zeros((b, h, tq, d), jnp.float32),
+        jnp.zeros((b, h, tq), jnp.float32),
+        jnp.full((b, h, tq), NEG_INF, jnp.float32),
+    )
+    (o, l, m), _ = jax.lax.scan(
+        body, init, (jnp.arange(num_k), k_blocks, v_blocks)
+    )
+    return o, l, m
+
+
 def _partial_stats_bwd(q, k, v, acc, l, ga, gl, gm, causal, scale,
-                       k_offset, block_k):
+                       k_offset, block_k, window=0):
     """Hand-written backward of ``(acc, l, m) = partial(q, k, v)`` that
     walks K in blocks, recomputing each [T, block_k] score tile — live
     memory is O(T x block_k) plus the O(T x D) grad accumulators, never
@@ -725,7 +791,8 @@ def _partial_stats_bwd(q, k, v, acc, l, ga, gl, gm, causal, scale,
 
     def scores(ki, kb):
         return _masked_block_scores(
-            qf, kb, ki, block_k, causal, scale, k_offset, q_pos
+            qf, kb, ki, block_k, causal, scale, k_offset, q_pos,
+            window=window,
         )
 
     # Pass 1: row max, recomputed so pass 3's indicator is exact.
@@ -788,25 +855,26 @@ def _partial_stats_bwd(q, k, v, acc, l, ga, gl, gm, causal, scale,
 
 
 def _flash_partial_fwd(q, k, v, causal, scale, block_q, block_k,
-                       interpret, k_offset):
+                       interpret, k_offset, window):
     out = _flash_partial(q, k, v, causal, scale, block_q, block_k,
-                         interpret, k_offset)
+                         interpret, k_offset, window)
     acc, l, _ = out
     return out, (q, k, v, acc, l)
 
 
 def _flash_partial_bwd(causal, scale, block_q, block_k, interpret,
-                       k_offset, res, g):
+                       k_offset, window, res, g):
     q, k, v, acc, l = res
     ga, gl, gm = g
     tk = k.shape[2]
     if tk % block_k == 0 and tk // block_k > 1:
         return _partial_stats_bwd(
             q, k, v, acc, l, ga, gl, gm, causal, scale, k_offset,
-            block_k,
+            block_k, window=window,
         )
     _, vjp = jax.vjp(
-        lambda q, k, v: _partial_ref(q, k, v, causal, scale, k_offset),
+        lambda q, k, v: _partial_ref(q, k, v, causal, scale, k_offset,
+                                     window=window),
         q, k, v,
     )
     return vjp((ga, gl, gm))
@@ -816,7 +884,8 @@ _flash_partial.defvjp(_flash_partial_fwd, _flash_partial_bwd)
 
 
 def flash_attention_partial(q, k, v, causal=True, scale=None, k_offset=0,
-                            block_q=128, block_k=128, interpret=False):
+                            block_q=128, block_k=128, interpret=False,
+                            window=0):
     """Unnormalized online-softmax block attention: returns
     (acc [B,H,T,D] f32, l [B,H,T] f32, m [B,H,T] f32) for this KV block,
     ready to fold into a running (o, l, m) state — the per-shard step of
@@ -829,10 +898,12 @@ def flash_attention_partial(q, k, v, causal=True, scale=None, k_offset=0,
     routes lower blocks as non-causal and skips upper ones) uses the jnp
     reference."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    _check_window(window, causal)
     t, d = q.shape[2], q.shape[3]
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     if (causal and k_offset != 0) or not _friendly(t, d, block_q, block_k):
-        return _partial_ref(q, k, v, causal, scale, k_offset)
+        return _partial_ref(q, k, v, causal, scale, k_offset,
+                            window=window)
     return _flash_partial(q, k, v, causal, scale, block_q, block_k,
-                          interpret, k_offset)
+                          interpret, k_offset, window)

@@ -214,6 +214,10 @@ def _bwd_kernel(x_ref, dy_ref, scale_ref, bias_ref, mean_ref, rstd_ref,
     bias = bias_ref[...].astype(jnp.float32)
     mean_c = mean_ref[0]                         # [1, C]
     rstd_c = rstd_ref[0]
+    # Same a/b association order as the forward so the ReLU mask is
+    # bit-identical on boundary elements (y == 0).
+    a_c = rstd_c * scale
+    b_c = bias - mean_c * a_c
 
     # Pass 1 (chunked): s1 = sum(dy), s2 = sum(dy * xhat) per channel
     # (dy already ReLU-masked).
@@ -222,10 +226,11 @@ def _bwd_kernel(x_ref, dy_ref, scale_ref, bias_ref, mean_ref, rstd_ref,
 
     def stats_body(i, _):
         sl = pl.ds(i * chunk, chunk)
-        xhat = (x_ref[0, sl, :].astype(jnp.float32) - mean_c) * rstd_c
+        xs = x_ref[0, sl, :].astype(jnp.float32)
+        xhat = (xs - mean_c) * rstd_c
         dy = dy_ref[0, sl, :].astype(jnp.float32)
         if relu:
-            dy = jnp.where(xhat * scale + bias > 0.0, dy, 0.0)
+            dy = jnp.where(xs * a_c + b_c > 0.0, dy, 0.0)
         s1_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
         s2_ref[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
         return 0
@@ -240,10 +245,11 @@ def _bwd_kernel(x_ref, dy_ref, scale_ref, bias_ref, mean_ref, rstd_ref,
 
     def dx_body(i, _):
         sl = pl.ds(i * chunk, chunk)
-        xhat = (x_ref[0, sl, :].astype(jnp.float32) - mean_c) * rstd_c
+        xs = x_ref[0, sl, :].astype(jnp.float32)
+        xhat = (xs - mean_c) * rstd_c
         dy = dy_ref[0, sl, :].astype(jnp.float32)
         if relu:
-            dy = jnp.where(xhat * scale + bias > 0.0, dy, 0.0)
+            dy = jnp.where(xs * a_c + b_c > 0.0, dy, 0.0)
         dx = rstd_c * (dy * scale - gsum_c - xhat * gxsum_c)
         dx_ref[0, sl, :] = dx.astype(dx_ref.dtype)
         return 0

@@ -35,7 +35,8 @@ from elasticdl_tpu.ops.flash_attention import (
 _NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale, mode="off"):
+def _ring_attention_local(q, k, v, axis_name, causal, scale, mode="off",
+                          window=0):
     """Per-device fold, [B, T/sp, H, D] shards in; the block math runs in
     [B, H, T, D] (the flash kernel's layout) and transposes back once."""
     axis_size = jax.lax.psum(1, axis_name)
@@ -47,15 +48,16 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale, mode="off"):
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
 
-    def partial(qT, kT, vT, block_causal):
+    def partial(qT, kT, vT, block_causal, block_window=0):
         if mode in ("tpu", "interpret"):
             return flash_attention_partial(
                 qT, kT, vT, causal=block_causal, scale=scale,
-                interpret=interpret,
+                interpret=interpret, window=block_window,
             )
         from elasticdl_tpu.ops.flash_attention import _partial_ref
 
-        return _partial_ref(qT, kT, vT, block_causal, scale, 0)
+        return _partial_ref(qT, kT, vT, block_causal, scale, 0,
+                            window=block_window)
 
     def skip_partial(qT):
         return (
@@ -79,7 +81,43 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale, mode="off"):
     def body(i, carry):
         o, l, m, kT, vT = carry
         src_rank = (rank - i) % axis_size
-        if causal:
+        if causal and window:
+            # Sliding window: the ring distance delta = rank - src picks
+            # the block's global diff range [delta*C - (C-1), delta*C +
+            # C-1] (C = shard length).  Fully above the diagonal OR
+            # entirely past the window -> skip; fully inside the band ->
+            # plain non-causal kernel; diagonal -> windowed causal
+            # kernel; straddling blocks (one or two consecutive ring
+            # steps, since the straddle interval spans up to 2C-2 diffs)
+            # run the blockwise banded partial with a rank-dependent
+            # k offset — O(C·block_k) live, never the dense square.
+            from elasticdl_tpu.ops.flash_attention import _partial_banded
+
+            delta = rank - src_rank
+
+            def banded(ops):
+                return _partial_banded(ops[0], ops[1], ops[2], scale,
+                                       -delta * tq, window)
+
+            acc_i, l_i, m_i = jax.lax.cond(
+                src_rank == rank,
+                lambda ops: partial(*ops, block_causal=True,
+                                    block_window=window),
+                lambda ops: jax.lax.cond(
+                    (src_rank > rank)
+                    | (delta * tq - (tq - 1) >= window),
+                    lambda o2: skip_partial(o2[0]),
+                    lambda o2: jax.lax.cond(
+                        delta * tq + tq - 1 < window,
+                        lambda o3: partial(*o3, block_causal=False),
+                        banded,
+                        o2,
+                    ),
+                    ops,
+                ),
+                (qT, kT, vT),
+            )
+        elif causal:
             # diagonal -> causal kernel; lower source rank -> full
             # (non-causal) kernel; higher -> entirely masked, skip.
             acc_i, l_i, m_i = jax.lax.cond(
@@ -117,6 +155,9 @@ def attention_local(q, k, v, causal=True, scale=None, mode=None,
     platform allows — this is the sp=1 hot path the flagship
     transformer hits; the jnp reference covers everything else.
     ``window`` > 0 = sliding-window causal attention."""
+    from elasticdl_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     mode = flash_mode() if mode is None else mode
     if mode in ("tpu", "interpret"):
@@ -146,15 +187,21 @@ def attention_local(q, k, v, causal=True, scale=None, mode=None,
 
 
 def ring_attention(q, k, v, mesh, causal=True, scale=None,
-                   dp_axis="dp", sp_axis="sp", tp_axis="tp"):
+                   dp_axis="dp", sp_axis="sp", tp_axis="tp", window=0):
     """Sequence-parallel attention over mesh axis ``sp``.
 
     q, k, v: [batch, seq, heads, head_dim] global arrays (or sharded).
     Falls back to local attention when the mesh has no sp extent.
+    ``window`` > 0 = sliding-window causal attention; ring steps whose
+    shard lies entirely outside the band skip compute AND the fold.
     """
+    from elasticdl_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if mesh is None:
-        return attention_local(q, k, v, causal=causal, scale=scale)
+        return attention_local(q, k, v, causal=causal, scale=scale,
+                               window=window)
     mode = flash_mode()
     if mesh.shape.get(sp_axis, 1) == 1:
         dp = mesh.shape.get(dp_axis, 1)
@@ -172,7 +219,7 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
             fn = shard_map(
                 functools.partial(
                     attention_local, causal=causal, scale=scale,
-                    mode=mode,
+                    mode=mode, window=window,
                 ),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
@@ -181,7 +228,8 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
             )
             return fn(q, k, v)
         return attention_local(
-            q, k, v, causal=causal, scale=scale, mode="off"
+            q, k, v, causal=causal, scale=scale, mode="off",
+            window=window,
         )
     sp = mesh.shape[sp_axis]
     tp = mesh.shape.get(tp_axis, 1)
@@ -200,6 +248,7 @@ def ring_attention(q, k, v, mesh, causal=True, scale=None,
         functools.partial(
             _ring_attention_local,
             axis_name=sp_axis, causal=causal, scale=scale, mode=mode,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

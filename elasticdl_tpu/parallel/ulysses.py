@@ -69,6 +69,9 @@ def ulysses_attention(q, k, v, mesh, causal=True, scale=None,
     Requires the per-tp-shard head count to be divisible by the sp
     extent.  Falls back to local attention when there is no sp extent.
     """
+    from elasticdl_tpu.ops.flash_attention import _check_window
+
+    _check_window(window, causal)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if mode is None:
         from elasticdl_tpu.ops.flash_attention import flash_mode
@@ -76,7 +79,7 @@ def ulysses_attention(q, k, v, mesh, causal=True, scale=None,
         mode = flash_mode()
     if mesh is None or mesh.shape.get(sp_axis, 1) == 1:
         return attention_local(q, k, v, causal=causal, scale=scale,
-                               mode=mode)
+                               mode=mode, window=window)
     sp = mesh.shape[sp_axis]
     tp = mesh.shape.get(tp_axis, 1)
     heads_local = q.shape[2] // tp
@@ -89,7 +92,7 @@ def ulysses_attention(q, k, v, mesh, causal=True, scale=None,
     fn = shard_map(
         functools.partial(
             _ulysses_local, sp_axis=sp_axis, causal=causal, scale=scale,
-            mode=mode,
+            mode=mode, window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
