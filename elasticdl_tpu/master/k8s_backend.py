@@ -46,6 +46,19 @@ LABEL_TYPE = "replica-type"
 LABEL_INDEX = "replica-index"
 
 
+def owner_ref_from_env(environ=None):
+    """The master pod's own identity (downward-API env POD_NAME /
+    POD_UID, injected by the client's submit manifest) as an
+    ownerReference dict — or None outside a cluster."""
+    import os
+
+    environ = os.environ if environ is None else environ
+    name, uid = environ.get("POD_NAME"), environ.get("POD_UID")
+    if not name or not uid:
+        return None
+    return {"name": name, "uid": uid}
+
+
 def load_cluster_spec(path):
     """Import a cluster-spec module ('pkg.mod') exporting optional
     patch_pod / patch_service hooks."""
@@ -54,28 +67,47 @@ def load_cluster_spec(path):
     return importlib.import_module(path)
 
 
+def apply_spec_hook(spec_mod, manifest, hook_name):
+    """Run a cluster-spec patch hook over a manifest dict (shared by
+    the worker backend and the client submit path)."""
+    hook = getattr(spec_mod, hook_name, None) if spec_mod else None
+    if hook is not None:
+        patched = hook(manifest)
+        if patched is not None:
+            return patched
+    return manifest
+
+
+def default_core_api():
+    """Real kubernetes CoreV1Api with in-cluster-else-kubeconfig
+    credentials (the one bootstrap both the backend and the client
+    submit path share)."""
+    try:
+        from kubernetes import client, config
+    except ImportError as e:
+        raise ImportError(
+            "this path needs the `kubernetes` package; install it in "
+            "the cluster image (locally, use the process backend or "
+            "--output manifest rendering)"
+        ) from e
+    try:
+        config.load_incluster_config()
+    except Exception:
+        config.load_kube_config()
+    return client.CoreV1Api()
+
+
 class K8sWorkerBackend:
     def __init__(self, job_name, image, namespace="default",
                  worker_args=None, resources=None, tpu_topology=None,
                  num_workers=0, high_priority_fraction=0.0,
                  priority_class_high="high-priority",
                  priority_class_low="", cluster_spec="",
-                 core_api=None, poll_secs=5.0):
-        if core_api is None:
-            try:
-                from kubernetes import client, config
-            except ImportError as e:
-                raise ImportError(
-                    "K8sWorkerBackend needs the `kubernetes` package; "
-                    "install it in the cluster image (the local image "
-                    "runs the process backend instead)"
-                ) from e
-            try:
-                config.load_incluster_config()
-            except Exception:
-                config.load_kube_config()
-            core_api = client.CoreV1Api()
-        self._core = core_api
+                 core_api=None, poll_secs=5.0, owner_ref=None):
+        # None = build the real client lazily on first API call, so the
+        # master can construct the backend (flag parsing, manifests)
+        # before cluster credentials are needed.
+        self._core_api = core_api
         self._job_name = job_name
         self._image = image
         self._namespace = namespace
@@ -91,7 +123,21 @@ class K8sWorkerBackend:
             if isinstance(cluster_spec, str) else cluster_spec
         )
         self._poll_secs = poll_secs
+        # Master pod identity: stamped as ownerReference on every worker
+        # pod/service, so deleting the master cascades the job (the
+        # reference's ownership model, common/k8s_client.py:354-357).
+        self._owner_ref = owner_ref
         self._exit_events = {}  # pod name -> threading.Event w/ .code
+
+    @property
+    def _core(self):
+        if self._core_api is None:
+            self._core_api = default_core_api()
+        return self._core_api
+
+    @_core.setter
+    def _core(self, api):
+        self._core_api = api
 
     def _pod_name(self, worker_id):
         return "%s-worker-%d" % (self._job_name, worker_id)
@@ -111,13 +157,20 @@ class K8sWorkerBackend:
             return self._priority_high
         return self._priority_low or None
 
-    def _apply_spec_hook(self, manifest, hook_name):
-        hook = getattr(self._cluster_spec, hook_name, None)
-        if hook is not None:
-            patched = hook(manifest)
-            if patched is not None:
-                manifest = patched
+    def _attach_owner(self, manifest):
+        if self._owner_ref:
+            manifest["metadata"]["ownerReferences"] = [{
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "name": self._owner_ref["name"],
+                "uid": self._owner_ref["uid"],
+                "controller": True,
+                "blockOwnerDeletion": True,
+            }]
         return manifest
+
+    def _apply_spec_hook(self, manifest, hook_name):
+        return apply_spec_hook(self._cluster_spec, manifest, hook_name)
 
     def pod_manifest(self, worker_id, master_addr, slot=None):
         slot = worker_id if slot is None else slot
@@ -155,6 +208,7 @@ class K8sWorkerBackend:
         priority = self._priority_class(slot)
         if priority:
             manifest["spec"]["priorityClassName"] = priority
+        self._attach_owner(manifest)
         return self._apply_spec_hook(manifest, "patch_pod")
 
     def service_manifest(self, worker_id, select_worker_id=None):
@@ -184,6 +238,7 @@ class K8sWorkerBackend:
                 "ports": [{"port": 50002, "targetPort": 50002}],
             },
         }
+        self._attach_owner(manifest)
         return self._apply_spec_hook(manifest, "patch_service")
 
     # -- WorkerManager backend surface --------------------------------------

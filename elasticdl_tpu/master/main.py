@@ -26,7 +26,32 @@ _MASTER_ONLY_ARGS = (
     "port", "num_workers", "num_ps", "shuffle", "shuffle_shards",
     "max_task_retries", "task_timeout_secs", "relaunch_on_worker_failure",
     "grads_to_wait", "sync_version_tolerance",
+    "worker_backend", "image", "namespace", "worker_resource_request",
+    "tpu_topology", "worker_pod_priority", "cluster_spec",
 )
+
+
+def _build_worker_backend(args, worker_args):
+    if args.worker_backend == "k8s":
+        from elasticdl_tpu.client.k8s_renderer import parse_resource_string
+        from elasticdl_tpu.master.k8s_backend import (
+            K8sWorkerBackend,
+            owner_ref_from_env,
+        )
+
+        return K8sWorkerBackend(
+            job_name=args.job_name,
+            image=args.image,
+            namespace=args.namespace,
+            worker_args=worker_args,
+            resources=parse_resource_string(args.worker_resource_request),
+            tpu_topology=args.tpu_topology or None,
+            num_workers=args.num_workers,
+            high_priority_fraction=args.worker_pod_priority,
+            cluster_spec=args.cluster_spec,
+            owner_ref=owner_ref_from_env(),
+        )
+    return ProcessWorkerBackend(worker_args=worker_args)
 
 
 def build_master(args):
@@ -128,17 +153,31 @@ def build_master(args):
         if ps_manager is not None:
             worker_args += ["--ps_addrs", ps_manager.addrs]
         worker_manager = WorkerManager(
-            ProcessWorkerBackend(worker_args=worker_args),
+            _build_worker_backend(args, worker_args),
             num_workers=args.num_workers,
             max_relaunch_count=args.relaunch_on_worker_failure,
         )
+    port = args.port
+    if args.worker_backend == "k8s" and not port:
+        # Pods dial the master through its Service, whose targetPort is
+        # fixed (client/k8s_submit.py MASTER_PORT) — a free-port bind
+        # would be unreachable.
+        from elasticdl_tpu.client.k8s_submit import MASTER_PORT
+
+        port = MASTER_PORT
     master = Master(
         task_manager,
         rendezvous_server=rendezvous,
         evaluation_service=evaluation_service,
         worker_manager=worker_manager,
-        port=args.port,
+        port=port,
     )
+    if args.worker_backend == "k8s":
+        # Workers in other pods reach the master by its service DNS
+        # name, not localhost (the service the submit path created).
+        master.advertise_addr = "%s-master.%s.svc:%%d" % (
+            args.job_name, args.namespace
+        )
     master.ps_manager = ps_manager
     return master
 

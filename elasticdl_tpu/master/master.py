@@ -33,6 +33,10 @@ class Master:
         self._poll_secs = poll_secs
         self._server = None
         self.port = None
+        # How managed workers dial back.  None = "localhost:<port>"
+        # (process backend).  A k8s master advertises its service DNS
+        # name instead; "%d" if present is filled with the bound port.
+        self.advertise_addr = None
         self._stop_requested = threading.Event()
         self.servicer = MasterServicer(
             task_manager,
@@ -55,7 +59,10 @@ class Master:
             self.servicer, port=self._port
         )
         if self.worker_manager is not None:
-            self.worker_manager.set_master_addr("localhost:%d" % self.port)
+            addr = self.advertise_addr or "localhost:%d"
+            if "%d" in addr:
+                addr = addr % self.port
+            self.worker_manager.set_master_addr(addr)
             self.worker_manager.start()
 
     def _on_worker_exit(self, worker_id, should_relaunch):

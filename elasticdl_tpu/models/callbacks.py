@@ -25,35 +25,58 @@ class ModelExporter:
         self.checkpoint_dir = checkpoint_dir
         self.model_name = model_name
 
+    def _merged_embeddings(self):
+        """{table: (ids, values)} from the latest PS checkpoint."""
+        embeddings = {}
+        if not self.checkpoint_dir:
+            return embeddings, {}
+        from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+        saver = CheckpointSaver(self.checkpoint_dir)
+        try:
+            ckpt_dense, ckpt_emb, _version = saver.load()
+        except FileNotFoundError:
+            logger.warning("no checkpoint to merge for export")
+            return embeddings, {}
+        for name, (ids, values) in ckpt_emb.items():
+            if name.startswith("slot:"):
+                continue  # optimizer state is not part of the model
+            embeddings[name] = (ids, values)
+        return embeddings, ckpt_dense
+
     def on_train_end(self, trainer):
+        embeddings, ckpt_dense = self._merged_embeddings()
+        bundle = trainer.serving_bundle()
+        if bundle is not None:
+            # Preferred: standalone servable (StableHLO + npz weights,
+            # serving/export.py) — the SavedModel-role artifact.
+            from elasticdl_tpu.serving import export_servable
+
+            infer_fn, params, example = bundle
+            export_servable(
+                self.export_dir, infer_fn, params, example,
+                model_name=self.model_name,
+                version=getattr(trainer, "version", 0),
+                embeddings=embeddings,
+                dense_overrides=ckpt_dense,
+            )
+            return
+        # Fallback (no bundle): weights-only v1 export.
         os.makedirs(self.export_dir, exist_ok=True)
         payload = dict(trainer.export_parameters())
-        embeddings = {}
-        if self.checkpoint_dir:
-            from elasticdl_tpu.utils.checkpoint import CheckpointSaver
-
-            saver = CheckpointSaver(self.checkpoint_dir)
-            try:
-                ckpt_dense, ckpt_emb, version = saver.load()
-                payload.update(ckpt_dense)
-                for name, (ids, values) in ckpt_emb.items():
-                    if name.startswith("slot:"):
-                        continue  # optimizer state is not part of the model
-                    embeddings["emb_ids/" + name] = ids
-                    embeddings["emb_vals/" + name] = values
-            except FileNotFoundError:
-                logger.warning("no checkpoint to merge for export")
+        payload.update(ckpt_dense)
+        flat_emb = {}
+        for name, (ids, values) in embeddings.items():
+            flat_emb["emb_ids/" + name] = ids
+            flat_emb["emb_vals/" + name] = values
         path = os.path.join(self.export_dir, "model.npz")
         with open(path, "wb") as f:
-            np.savez(f, **payload, **embeddings)
+            np.savez(f, **payload, **flat_emb)
         manifest = {
             "model_name": self.model_name,
             "format": "elasticdl_tpu_export_v1",
             "parameters": sorted(payload),
-            "embedding_tables": sorted(
-                n[len("emb_ids/"):] for n in embeddings
-                if n.startswith("emb_ids/")
-            ),
+            "embedding_tables": sorted(embeddings),
             "version": getattr(trainer, "version", 0),
         }
         with open(os.path.join(self.export_dir, "manifest.json"),

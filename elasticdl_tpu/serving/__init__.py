@@ -1,0 +1,1 @@
+from elasticdl_tpu.serving.export import export_servable  # noqa: F401

@@ -1,0 +1,117 @@
+"""Servable model export — the SavedModel analog, TPU-native.
+
+The reference's train-end task exports a SavedModel that any TF-serving
+stack can load (elasticdl/python/elasticdl/callbacks.py:23-66,
+common/model_handler.py:242-269).  The XLA-world equivalent of "graph +
+weights in a standard container" is **StableHLO via jax.export**: the
+jitted inference function is serialized portably (lowered for BOTH cpu
+and tpu by default), weights ride beside it as a plain ``model.npz``,
+and a JSON manifest documents the whole layout.
+
+Export layout (format ``elasticdl_tpu_servable_v2``)::
+
+    export_dir/
+      manifest.json     format tag, model name/version, input signature,
+                        parameter names, embedding table names, platforms
+      model.npz         {slash/joined/name: ndarray} flat weights
+                        (+ emb_ids/<t>, emb_vals/<t> embedding tables)
+      model.stablehlo   jax.export serialization of
+                        fn(flat_params_dict, inputs) -> outputs
+
+Anything that can read npz + deserialize StableHLO can serve the model —
+``elasticdl_tpu.serving.loader`` is the reference loader and imports
+NOTHING from the training framework (master/worker/ps).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
+
+logger = get_logger(__name__)
+
+FORMAT = "elasticdl_tpu_servable_v2"
+
+
+def _signature(tree):
+    """Input/output pytree -> JSON-able {shape, dtype} skeleton."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: {"shape": list(np.shape(a)),
+                   "dtype": str(np.asarray(a).dtype)},
+        tree,
+    )
+
+
+def export_servable(export_dir, apply_fn, params, example_input,
+                    model_name="", version=0, embeddings=None,
+                    dense_overrides=None, platforms=("cpu", "tpu")):
+    """Write a standalone servable export.
+
+    apply_fn: (params_pytree, inputs) -> outputs (inference mode —
+    close over train=False before passing).  example_input: a pytree of
+    arrays fixing the serving signature (values are ignored, only
+    shape/dtype matter).  embeddings: {table: (ids, values)} from the
+    PS checkpoint merge.  dense_overrides: {flat_name: ndarray} taking
+    precedence over ``params`` (the PS checkpoint's newer dense state).
+    """
+    import jax
+    from jax import export as jax_export
+
+    os.makedirs(export_dir, exist_ok=True)
+    params = to_numpy(params)
+    flat, treedef = flatten_with_names(params)
+    for name, value in (dense_overrides or {}).items():
+        if name in flat and np.shape(value) == np.shape(flat[name]):
+            flat[name] = np.asarray(value, flat[name].dtype)
+    # Leaf order straight from the treedef (flatten_with_names preserves
+    # it) — string-sorting the joined names would NOT reproduce it for
+    # every name alphabet.
+    names_in_order = list(flat)
+
+    def serve_fn(flat_params, inputs):
+        tree = jax.tree_util.tree_unflatten(
+            treedef, [flat_params[n] for n in names_in_order]
+        )
+        return apply_fn(tree, inputs)
+
+    flat_specs = {
+        n: jax.ShapeDtypeStruct(v.shape, v.dtype) for n, v in flat.items()
+    }
+    input_specs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        example_input,
+    )
+    exported = jax_export.export(
+        jax.jit(serve_fn), platforms=list(platforms)
+    )(flat_specs, input_specs)
+
+    payload = dict(flat)
+    table_names = []
+    for name, (ids, values) in (embeddings or {}).items():
+        payload["emb_ids/" + name] = ids
+        payload["emb_vals/" + name] = values
+        table_names.append(name)
+    with open(os.path.join(export_dir, "model.npz"), "wb") as f:
+        np.savez(f, **payload)
+    with open(os.path.join(export_dir, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+    manifest = {
+        "format": FORMAT,
+        "model_name": model_name,
+        "version": version,
+        "platforms": list(platforms),
+        "parameters": sorted(flat),
+        "embedding_tables": sorted(table_names),
+        "input_signature": _signature(example_input),
+        "loader": "elasticdl_tpu.serving.loader:load_servable",
+    }
+    with open(os.path.join(export_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    logger.info("servable export at %s (%d tensors, %d tables)",
+                export_dir, len(flat), len(table_names))
+    return manifest

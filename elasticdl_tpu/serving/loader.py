@@ -1,0 +1,66 @@
+"""Standalone loader for ``elasticdl_tpu_servable_v2`` exports.
+
+Deliberately imports NOTHING from the training framework — only numpy,
+json, and (for execution) jax's StableHLO deserializer.  Copy this one
+file into a serving process, point it at an export directory, call
+``predict``.  Parity: the role of loading the reference's exported
+SavedModel in a TF-serving stack (model_handler.py:242-269) — here the
+portable artifact is StableHLO + npz instead of GraphDef + variables.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+class ServableModel:
+    def __init__(self, export_dir):
+        self.export_dir = export_dir
+        with open(os.path.join(export_dir, "manifest.json")) as f:
+            self.manifest = json.load(f)
+        fmt = self.manifest.get("format", "")
+        if not fmt.startswith("elasticdl_tpu_servable"):
+            raise ValueError("not a servable export: format=%r" % fmt)
+        self.params = {}
+        self.embeddings = {}
+        with np.load(os.path.join(export_dir, "model.npz")) as z:
+            for key in z.files:
+                if key.startswith("emb_ids/"):
+                    name = key[len("emb_ids/"):]
+                    self.embeddings[name] = (
+                        z[key], z["emb_vals/" + name]
+                    )
+                elif not key.startswith("emb_vals/"):
+                    self.params[key] = z[key]
+        self._exported = None
+
+    @property
+    def exported(self):
+        if self._exported is None:
+            from jax import export as jax_export
+
+            with open(os.path.join(self.export_dir,
+                                   "model.stablehlo"), "rb") as f:
+                self._exported = jax_export.deserialize(f.read())
+        return self._exported
+
+    def predict(self, inputs):
+        """Run the exported inference function on ``inputs`` (an array
+        or pytree matching manifest['input_signature'])."""
+        return self.exported.call(self.params, inputs)
+
+    def lookup_embedding(self, table, ids, default=0.0):
+        """Host-side embedding lookup for PS-trained tables."""
+        known_ids, values = self.embeddings[table]
+        index = {int(i): row for i, row in zip(known_ids, values)}
+        dim = values.shape[1] if values.ndim > 1 else 1
+        out = np.full((len(ids), dim), default, values.dtype)
+        for j, i in enumerate(np.asarray(ids).tolist()):
+            if int(i) in index:
+                out[j] = index[int(i)]
+        return out
+
+
+def load_servable(export_dir):
+    return ServableModel(export_dir)

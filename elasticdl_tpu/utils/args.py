@@ -65,6 +65,23 @@ def build_master_parser():
     parser.add_argument("--max_task_retries", type=int, default=3)
     parser.add_argument("--task_timeout_secs", type=float, default=300)
     parser.add_argument("--relaunch_on_worker_failure", type=int, default=3)
+    # k8s worker backend (in-cluster master; reference pod_manager flags)
+    parser.add_argument("--worker_backend", default="process",
+                        choices=["process", "k8s"])
+    parser.add_argument("--image", default="elasticdl-tpu:latest",
+                        help="worker container image (k8s backend)")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--worker_resource_request",
+                        default="cpu=1,memory=2Gi",
+                        help="k8s resources per worker pod")
+    parser.add_argument("--tpu_topology", default="",
+                        help="gke-tpu-topology node selector value")
+    parser.add_argument("--worker_pod_priority", type=float, default=0.0,
+                        help="fraction of workers on the high priority "
+                             "class (reference --worker_pod_priority)")
+    parser.add_argument("--cluster_spec", default="",
+                        help="dotted module with patch_pod/patch_service "
+                             "hooks")
     return parser
 
 

@@ -89,6 +89,7 @@ class CollectiveTrainer(Trainer):
         self._version = 0
         self._ckpt_executor = None
         self._ckpt_future = None
+        self._example_features = None
 
         params = spec.init_fn(jax.random.PRNGKey(rng_seed))
         self._opt_state = spec.optimizer.init(params)
@@ -279,6 +280,13 @@ class CollectiveTrainer(Trainer):
         return features, labels, weights
 
     def train_minibatch(self, features, labels):
+        if self._example_features is None:
+            # Shape/dtype skeleton of one raw minibatch — fixes the
+            # serving signature of the train-end servable export.
+            self._example_features = jax.tree_util.tree_map(
+                lambda a: np.zeros(np.shape(a), np.asarray(a).dtype),
+                features,
+            )
         with self.timing.timeit("batch_process"):
             if self._accum_steps == 1:
                 total = self._batch_size * self.global_device_count
@@ -360,6 +368,18 @@ class CollectiveTrainer(Trainer):
     def export_parameters(self):
         named, _ = flatten_with_names(to_numpy(self._params))
         return named
+
+    def serving_bundle(self):
+        """(inference_fn, params, example_input) for the servable
+        export; None before the first minibatch fixed the signature."""
+        if self._example_features is None:
+            return None
+        apply_fn = self._spec.apply_fn
+        return (
+            lambda p, x: apply_fn(p, x, False),
+            to_numpy(self._params),
+            self._example_features,
+        )
 
     def save_checkpoint(self):
         """Params AND optimizer state (``opt/``-prefixed, mirroring

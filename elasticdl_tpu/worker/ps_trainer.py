@@ -73,6 +73,7 @@ class ParameterServerTrainer(Trainer):
         self._version = 0
         self._steps = 0
         self._grad_step = None
+        self._example_serving_input = None
         self._eval_step = None
         self._push_model_to_init()
 
@@ -209,6 +210,16 @@ class ParameterServerTrainer(Trainer):
             (features, labels), self._batch_size
         )
         features, emb_inputs, push_info = self._prepare_embeddings(features)
+        if self._example_serving_input is None:
+            # Serving signature: feature dict with the looked-up
+            # emb__<table> rows merged in, exactly what apply_fn sees.
+            merged = dict(features) if emb_inputs else features
+            for table, rows in (emb_inputs or {}).items():
+                merged["emb__" + table] = rows
+            self._example_serving_input = jax.tree_util.tree_map(
+                lambda a: np.zeros(np.shape(a), np.asarray(a).dtype),
+                merged,
+            )
         if self._grad_step is None:
             self._grad_step = self._build_grad_step()
         with self.timing.timeit("batch_process"):
@@ -271,3 +282,18 @@ class ParameterServerTrainer(Trainer):
     def export_parameters(self):
         named, _ = flatten_with_names(to_numpy(self._params))
         return named
+
+    def serving_bundle(self):
+        """Servable over (dense params, features+emb__rows): the server
+        looks embedding rows up host-side from the exported tables
+        (serving/loader.py lookup_embedding) and feeds them as
+        emb__<table> inputs — the PS-path analog of the reference's
+        localized SavedModel (model_handler.py:171-236)."""
+        if self._example_serving_input is None:
+            return None
+        apply_fn = self._spec.apply_fn
+        return (
+            lambda p, x: apply_fn(p, x, False),
+            to_numpy(self._params),
+            self._example_serving_input,
+        )

@@ -22,3 +22,9 @@ class Trainer(abc.ABC):
     def export_parameters(self):
         """Return {name: ndarray} of the current model parameters."""
         raise NotImplementedError
+
+    def serving_bundle(self):
+        """Optional (inference_fn, params_pytree, example_input) triple
+        for a standalone servable export (serving/export.py); None when
+        the trainer can't provide one."""
+        return None
