@@ -7,13 +7,25 @@ framework's jitted train step in bfloat16 on one TPU chip, with the batch
 resident on device (synthetic data; the data plane is benchmarked
 separately).
 
-Robustness against a flaky TPU relay (VERDICT r1 #1, r2 #1b):
+Robustness against a flaky TPU relay (VERDICT r1 #1, r2 #1b, r3 #1):
  - persistent XLA compilation cache under .jax_cache/ so a re-run after a
    relay hiccup skips the 20-40 s compile;
- - every measurement runs in a watchdog subprocess, and ALL attempts
-   share one total wall-clock budget (ELASTICDL_BENCH_TOTAL_BUDGET,
-   default 600 s — under the driver's kill deadline) with a reserve held
-   back so the JSON line always prints;
+ - a cheap PROBE subprocess (import + devices() + tiny matmul, <=90 s)
+   runs first: when the relay is wedged, ``jax.devices()`` blocks forever
+   inside PJRT client init, and round 3 lost its entire 600 s budget to
+   exactly that inside one full-budget measurement attempt.  Probes fail
+   fast and are retried; only a healthy relay earns a measurement run;
+ - every measurement runs in a watchdog subprocess, ALL attempts share
+   one total wall-clock budget (ELASTICDL_BENCH_TOTAL_BUDGET, default
+   600 s) with a reserve held back so the JSON line always prints, and
+   attempt 1 is capped at ~45% of the budget so a warm-cache attempt 2
+   always fits (r3 weak #1: attempt 1 used to consume everything);
+ - the inner process streams progress markers to stderr
+   (``BENCHMARK-MARK <phase>``); on timeout the last marker is folded
+   into the failure JSON so a timeout says WHERE it died;
+ - if the relay never answers a probe, a CPU measurement runs instead:
+   the JSON then carries a real (if small) number with
+   ``platform: "cpu"`` and the probe history, never ``value: null``;
  - after a successful batch-128 run, leftover budget goes to improvement
    candidates (fused GroupNorm, batch 256, steps-per-loop) and the best
    number wins.
@@ -43,7 +55,43 @@ CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".jax_cache")
 
 
+def _mark(phase):
+    """Progress marker for the watchdog (folded into failure JSON)."""
+    print("BENCHMARK-MARK %s" % phase, file=sys.stderr, flush=True)
+
+
+def run_probe():
+    """Fail-fast relay health check: import, devices(), one tiny matmul.
+
+    Runs under a short subprocess timeout.  A wedged relay blocks inside
+    ``jax.devices()`` (PJRT client init) — this burns <=90 s instead of
+    the whole budget.  Uses the same persistent compilation cache as the
+    measurement so its matmul compile is amortized across runs.
+    """
+    _mark("probe_imports")
+    import jax
+
+    if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+        jax.config.update(
+            "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError:
+        pass
+    _mark("probe_devices_start")
+    platform = jax.devices()[0].platform
+    _mark("probe_devices_ok:%s" % platform)
+    import jax.numpy as jnp
+
+    y = float((jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum())
+    _mark("probe_matmul_ok")
+    print("PROBE-OK %s %.0f" % (platform, y))
+
+
 def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
+    _mark("imports_start")
     import jax
 
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
@@ -64,11 +112,14 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
     from elasticdl_tpu.models import resnet
     from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
 
+    _mark("imports_done")
     platform = jax.devices()[0].platform
+    _mark("devices_ok:%s" % platform)
     if platform == "cpu":
         # Keep the CPU fallback fast enough to not time out; the real
-        # number comes from the TPU run.
-        batch_size, warmup, iters = 16, 1, 3
+        # number comes from the TPU run.  (A smaller requested batch is
+        # honored — the wedged-relay fallback path uses batch 8.)
+        batch_size, warmup, iters = min(batch_size, 16), 1, 3
 
     spec = resnet.model_spec(variant="resnet50", num_classes=1000,
                              image_size=224, learning_rate=0.1)
@@ -93,21 +144,27 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
         iters = max(2, iters // fused_steps)
     else:
         step = trainer._train_step
+    _mark("compile_start")
     compile_start = time.perf_counter()
     params, opt_state, loss = step(params, opt_state, xs, ys, ws)
     float(loss)  # fence
     compile_secs = time.perf_counter() - compile_start
+    _mark("compile_done:%.1fs" % compile_secs)
     # A cache hit makes the first call cheap; skip further warmup then.
     remaining_warmup = 1 if compile_secs < 5.0 else warmup - 1
     for _ in range(remaining_warmup):
         params, opt_state, loss = step(params, opt_state, xs, ys, ws)
     float(loss)  # fence
+    _mark("warmup_done")
 
     start = time.perf_counter()
-    for _ in range(iters):
+    for k in range(iters):
         params, opt_state, loss = step(params, opt_state, xs, ys, ws)
+        if k % 5 == 4:
+            _mark("iter:%d/%d" % (k + 1, iters))
     last_loss = float(loss)  # fence
     elapsed = time.perf_counter() - start
+    _mark("measured")
 
     steps_done = iters * max(1, fused_steps)
     images_per_sec = batch_size * steps_done / elapsed
@@ -137,24 +194,69 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
     }
 
 
-def _run_inner(batch_size, timeout_secs, fused=0, env=None):
-    """One watchdog'd measurement subprocess; returns (result|None, reason)."""
+def _last_mark(stderr_text):
+    """Latest BENCHMARK-MARK phase in a (possibly partial) stderr dump."""
+    last = "none"
+    for line in (stderr_text or "").splitlines():
+        if line.startswith("BENCHMARK-MARK "):
+            last = line[len("BENCHMARK-MARK "):].strip()
+    return last
+
+
+def _run_sub(argv, timeout_secs, env=None):
+    """One watchdog'd subprocess; returns (stdout|None, reason).
+
+    On timeout the child's partial stderr is parsed for the last
+    progress marker, so the reason says where the child died
+    (VERDICT r3 #1b: three rounds of timeouts never said whether the
+    time went to init, compile, or the measured loop).
+    """
     try:
         proc = subprocess.run(
-            [sys.executable, __file__, "--inner",
-             "--batch", str(batch_size), "--fused", str(fused)],
+            [sys.executable, __file__] + argv,
             capture_output=True, text=True, timeout=timeout_secs,
             env={**os.environ, **(env or {})},
         )
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                return json.loads(line), ""
-        return None, "no JSON output; stderr: %s" % (proc.stderr or "")[-300:]
-    except subprocess.TimeoutExpired:
-        return None, "timed out after %ds" % timeout_secs
-    except (OSError, json.JSONDecodeError) as e:
+        if proc.returncode != 0:
+            # Return stdout anyway: a crash during interpreter/PJRT
+            # teardown AFTER the JSON line printed must not discard a
+            # completed measurement — callers validate the payload.
+            return proc.stdout, "exit %d at %s; stderr: %s" % (
+                proc.returncode, _last_mark(proc.stderr),
+                (proc.stderr or "")[-300:])
+        return proc.stdout, ""
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        return None, "timed out after %ds at %s" % (
+            timeout_secs, _last_mark(stderr))
+    except OSError as e:
         return None, "%s: %s" % (type(e).__name__, e)
+
+
+def _run_inner(batch_size, timeout_secs, fused=0, env=None):
+    """One watchdog'd measurement subprocess; returns (result|None, reason)."""
+    stdout, reason = _run_sub(
+        ["--inner", "--batch", str(batch_size), "--fused", str(fused)],
+        timeout_secs, env=env,
+    )
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError as e:
+                return None, "bad JSON: %s" % e
+    return None, reason or "no JSON output"
+
+
+def _probe(timeout_secs, env=None):
+    """Fail-fast relay health check; returns (ok, reason)."""
+    stdout, reason = _run_sub(["--probe"], timeout_secs, env=env)
+    if stdout and "PROBE-OK" in stdout:
+        return True, ""
+    return False, reason or "probe produced no PROBE-OK"
 
 
 def _run_with_watchdog():
@@ -182,21 +284,78 @@ def _run_with_watchdog():
 
     failures = []
     result = None
-    # batch 128 / XLA-GN is the known-good configuration; retry once on
-    # timeout if budget allows (the first attempt may have populated the
-    # compilation cache before the relay hiccuped, making retry cheap).
-    for attempt in range(2):
-        budget = remaining()
-        if budget < 60:
-            failures.append("b128 attempt %d: skipped, %ds left"
-                            % (attempt + 1, int(budget)))
+
+    # Insurance: start a CPU measurement CONCURRENTLY at t=0.  If the
+    # relay never yields a TPU number, this stash is harvested at the
+    # end — a small honest number (platform:"cpu" in the detail) beats
+    # value:null.  If a TPU number lands, the stash is killed unused.
+    cpu_stash = subprocess.Popen(
+        [sys.executable, __file__, "--inner", "--batch", "8"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "ELASTICDL_FUSED_GN": "off",
+             "ELASTICDL_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"},
+    )
+
+    # Phase 0: probe until the relay answers.  Each probe costs <=90 s
+    # (a wedged relay blocks forever in PJRT client init; the probe eats
+    # that hang so a full-budget measurement attempt never does).
+    relay_ok = False
+    probes = 0
+    while remaining() > 75:
+        probes += 1
+        ok, reason = _probe(min(90, int(remaining() - 30)))
+        if ok:
+            relay_ok = True
             break
-        result, reason = _run_inner(
-            128, budget, env={"ELASTICDL_FUSED_GN": "off"}
-        )
+        failures.append("probe %d: %s" % (probes, reason))
+        if remaining() > 120:
+            time.sleep(10)  # give a mid-restart relay a moment
+    if not relay_ok:
+        failures.append("relay never answered %d probes" % probes)
+
+    if relay_ok:
+        # batch 128 / XLA-GN is the known-good configuration.  Attempt 1
+        # is capped at ~45% of the total budget so a warm-cache attempt 2
+        # always fits (r3: attempt 1 got the whole budget, so the retry
+        # mechanism could never fire on the path it was built for).
+        for attempt in range(2):
+            budget = remaining()
+            if budget < 60:
+                failures.append("b128 attempt %d: skipped, %ds left"
+                                % (attempt + 1, int(budget)))
+                break
+            if attempt == 0:
+                budget = min(budget, int(total_budget * 0.45))
+            result, reason = _run_inner(
+                128, int(budget), env={"ELASTICDL_FUSED_GN": "off"}
+            )
+            if result is not None:
+                break
+            failures.append("b128 attempt %d: %s" % (attempt + 1, reason))
+
+    if result is None:
+        # Harvest the CPU stash (it has been running since t=0).
+        try:
+            stdout, _ = cpu_stash.communicate(timeout=max(5, remaining()))
+            for line in reversed((stdout or "").strip().splitlines()):
+                if line.strip().startswith("{"):
+                    result = json.loads(line.strip())
+                    break
+        except (subprocess.TimeoutExpired, json.JSONDecodeError,
+                OSError) as e:
+            cpu_stash.kill()
+            cpu_stash.wait()
+            failures.append("cpu stash: %s" % type(e).__name__)
         if result is not None:
-            break
-        failures.append("b128 attempt %d: %s" % (attempt + 1, reason))
+            result["detail"]["note"] = (
+                "CPU FALLBACK — TPU relay unreachable; not comparable "
+                "to the TPU numbers in BENCHMARKS.md (last TPU capture "
+                "2026-07-29: 2352.3 img/s, 16.2x baseline)")
+            result["detail"]["tpu_failures"] = failures
+    else:
+        cpu_stash.kill()
+        cpu_stash.wait()
+
     if result is None:
         return {
             "metric": "resnet50_train_throughput",
@@ -212,6 +371,8 @@ def _run_with_watchdog():
                         "(16.2x baseline)",
             },
         }
+    if failures and "tpu_failures" not in result["detail"]:
+        result["detail"]["recovered_from"] = failures
     # With a number in hand, spend ONLY leftover budget on improvement
     # candidates; keep whichever throughput is higher.  Each candidate is
     # an independent subprocess, so a compile hang costs at most the time
@@ -246,7 +407,9 @@ def _run_with_watchdog():
 
 
 if __name__ == "__main__":
-    if "--inner" in sys.argv:
+    if "--probe" in sys.argv:
+        run_probe()
+    elif "--inner" in sys.argv:
         batch = 128
         fused = 0
         if "--batch" in sys.argv:
