@@ -137,3 +137,37 @@ def test_embedding_lookup(tmp_path):
     np.testing.assert_array_equal(rows[0], [4, 5, 6, 7])
     np.testing.assert_array_equal(rows[1], [0, 0, 0, 0])  # unknown id
     np.testing.assert_array_equal(rows[2], [0, 1, 2, 3])
+
+
+def test_embedding_lookup_large_table_is_o_batch(tmp_path):
+    """100k-row table: lookups must use the index built once in
+    __init__, not rebuild an O(table) dict per call (VERDICT r3 #7)."""
+    import time
+
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.loader import load_servable
+
+    n = 100_000
+    rng = np.random.RandomState(0)
+    ids = rng.permutation(n * 2)[:n]  # unsorted, sparse id space
+    values = rng.randn(n, 8).astype(np.float32)
+    export_servable(
+        str(tmp_path / "e"),
+        lambda p, x: x * p["s"],
+        {"s": np.float32(1.0)},
+        np.zeros((2, 3), np.float32),
+        embeddings={"items": (ids, values)},
+        platforms=("cpu",),
+    )
+    model = load_servable(str(tmp_path / "e"))
+    query = np.concatenate([ids[:64], [n * 2 + 7]])  # 64 hits + 1 miss
+    t0 = time.perf_counter()
+    for _ in range(100):
+        rows = model.lookup_embedding("items", query)
+    per_call = (time.perf_counter() - t0) / 100
+    np.testing.assert_allclose(rows[:64], values[:64])
+    np.testing.assert_array_equal(rows[64], np.zeros(8, np.float32))
+    # The old dict-rebuild path costs ~30ms/call at 100k rows; the
+    # searchsorted path is far under 5ms even on a loaded CI box.
+    assert per_call < 0.005, "lookup is O(table): %.1f ms" % (
+        per_call * 1e3)
