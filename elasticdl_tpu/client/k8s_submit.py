@@ -35,13 +35,33 @@ logger = get_logger(__name__)
 MASTER_PORT = 50001
 
 
-def _job_name_from_argv(master_argv):
+def _argv_value(master_argv, flag, default=None):
     for i, arg in enumerate(master_argv):
-        if arg == "--job_name" and i + 1 < len(master_argv):
+        if arg == flag and i + 1 < len(master_argv):
             return master_argv[i + 1]
-        if arg.startswith("--job_name="):
+        if arg.startswith(flag + "="):
             return arg.split("=", 1)[1]
-    return "elasticdl-tpu-job"
+    return default
+
+
+def _job_name_from_argv(master_argv):
+    return _argv_value(master_argv, "--job_name", "elasticdl-tpu-job")
+
+
+def _port_from_argv(master_argv):
+    """The port the in-cluster master will bind (Service must match).
+
+    An explicit ``--port`` in the job args parameterizes the Service
+    port/targetPort; otherwise the master falls back to MASTER_PORT
+    (master/main.py) and so does the Service.
+    """
+    port = _argv_value(master_argv, "--port")
+    try:
+        port = int(port) if port else 0
+    except ValueError:
+        raise ValueError(
+            "--port must be an integer, got %r" % port) from None
+    return port or MASTER_PORT
 
 
 def master_pod_name(job_name):
@@ -103,7 +123,8 @@ def master_pod_manifest(master_argv, image, namespace="default",
     }
 
 
-def master_service_manifest(job_name, namespace="default"):
+def master_service_manifest(job_name, namespace="default",
+                            port=MASTER_PORT):
     return {
         "apiVersion": "v1",
         "kind": "Service",
@@ -121,8 +142,7 @@ def master_service_manifest(job_name, namespace="default"):
                 LABEL_JOB: job_name,
                 LABEL_TYPE: "master",
             },
-            "ports": [{"port": MASTER_PORT,
-                       "targetPort": MASTER_PORT}],
+            "ports": [{"port": port, "targetPort": port}],
         },
     }
 
@@ -152,7 +172,9 @@ def build_manifests(master_argv, image, namespace="default",
         master_argv, image, namespace=namespace, job_name=job_name,
         resources=resources, envs=envs,
     )
-    svc = master_service_manifest(job_name, namespace=namespace)
+    svc = master_service_manifest(
+        job_name, namespace=namespace, port=_port_from_argv(master_argv)
+    )
     return (
         apply_spec_hook(spec_mod, pod, "patch_pod"),
         apply_spec_hook(spec_mod, svc, "patch_service"),

@@ -68,6 +68,13 @@ def _run_job(job_type, argv, core_api=None):
         "--image", cli_args.image,
         "--cluster_spec", cli_args.cluster_spec,
     ]
+    if not any(a == "--worker_backend" or a.startswith("--worker_backend=")
+               for a in master_argv):
+        # A cluster submission wants worker PODS; without this the
+        # in-cluster master would run workers as subprocesses inside its
+        # own cpu=1 pod (worker_backend defaults to "process").  An
+        # explicit --worker_backend in the job args still wins.
+        master_argv += ["--worker_backend", "k8s"]
     resources = parse_resource_string(cli_args.master_resource_request)
     if cli_args.output is not None:
         manifest = k8s_submit.render_manifests(

@@ -26,26 +26,41 @@ class ModelExporter:
         self.model_name = model_name
 
     def _merged_embeddings(self):
-        """{table: (ids, values)} from the latest PS checkpoint."""
+        """({table: (ids, values)}, dense, version) from the latest PS
+        checkpoint (version None when there is no checkpoint)."""
         embeddings = {}
         if not self.checkpoint_dir:
-            return embeddings, {}
+            return embeddings, {}, None
         from elasticdl_tpu.utils.checkpoint import CheckpointSaver
 
         saver = CheckpointSaver(self.checkpoint_dir)
         try:
-            ckpt_dense, ckpt_emb, _version = saver.load()
+            ckpt_dense, ckpt_emb, version = saver.load()
         except FileNotFoundError:
             logger.warning("no checkpoint to merge for export")
-            return embeddings, {}
+            return embeddings, {}, None
         for name, (ids, values) in ckpt_emb.items():
             if name.startswith("slot:"):
                 continue  # optimizer state is not part of the model
             embeddings[name] = (ids, values)
-        return embeddings, ckpt_dense
+        return embeddings, ckpt_dense, version
 
     def on_train_end(self, trainer):
-        embeddings, ckpt_dense = self._merged_embeddings()
+        embeddings, ckpt_dense, ckpt_version = self._merged_embeddings()
+        if (
+            ckpt_dense
+            and ckpt_version is not None
+            and ckpt_version < getattr(trainer, "version", 0)
+        ):
+            # The trainer's in-memory train-end params are NEWER than the
+            # last checkpoint (collective trainer with a checkpoint_dir):
+            # overriding name/shape-matching params would export stale
+            # weights.  Keep only PS-side names the trainer doesn't hold.
+            trainer_names = set(dict(trainer.export_parameters()))
+            ckpt_dense = {
+                n: v for n, v in ckpt_dense.items()
+                if n not in trainer_names
+            }
         bundle = trainer.serving_bundle()
         if bundle is not None:
             # Preferred: standalone servable (StableHLO + npz weights,

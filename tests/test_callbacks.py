@@ -52,6 +52,42 @@ def test_model_exporter_merges_ps_checkpoint(tmp_path):
     assert sorted(ids.tolist()) == [1, 2]
 
 
+def test_model_exporter_skips_stale_checkpoint_dense(tmp_path):
+    """A checkpoint OLDER than the trainer's train-end params must not
+    override matching dense weights (ADVICE r3: a collective trainer's
+    last checkpoint can lag the final step); PS-side-only names still
+    merge in."""
+    spec = mnist.model_spec()
+    trainer = CollectiveTrainer(spec, batch_size=8)
+    xs, ys = mnist.synthetic_data(n=8)
+    trainer.train_minibatch(xs, ys)
+    live = dict(trainer.export_parameters())
+    name = sorted(live)[0]
+    assert trainer.version > 0
+    ckpt = CheckpointSaver(str(tmp_path / "ckpt"))
+    ckpt.save(
+        0,  # older than trainer.version
+        dense={name: np.zeros_like(live[name])},
+        embeddings={},
+    )
+    export_dir = str(tmp_path / "export")
+    ModelExporter(
+        export_dir, checkpoint_dir=str(tmp_path / "ckpt")
+    ).on_train_end(trainer)
+    dense, _ = load_export(export_dir)
+    np.testing.assert_array_equal(dense[name], live[name])  # not zeros
+
+    # ... and a checkpoint at/after the trainer's version IS authoritative
+    ckpt.save(trainer.version,
+              dense={name: np.zeros_like(live[name])}, embeddings={})
+    ModelExporter(
+        str(tmp_path / "export2"), checkpoint_dir=str(tmp_path / "ckpt")
+    ).on_train_end(trainer)
+    dense2, _ = load_export(str(tmp_path / "export2"))
+    np.testing.assert_array_equal(dense2[name],
+                                  np.zeros_like(live[name]))
+
+
 def test_lr_scheduler_sets_ps_trainer_lr():
     class FakeTrainer:
         version = 100

@@ -2,7 +2,6 @@
 
 import os
 
-from elasticdl_tpu.client import k8s_renderer
 from elasticdl_tpu.client.main import _split_args, _zoo_init, main
 
 
@@ -39,15 +38,30 @@ def test_split_args_passthrough():
 
 
 def test_k8s_manifest_renders_master_pod():
-    manifest = k8s_renderer.render_master_manifest(
+    from elasticdl_tpu.client.k8s_submit import render_manifests
+
+    manifest = render_manifests(
         ["--job_name", "myjob", "--model_zoo", "mnist"],
         image="img:2", namespace="ml",
     )
-    assert "name: myjob-master" in manifest
-    assert "namespace: ml" in manifest
-    assert "image: img:2" in manifest
-    assert "elasticdl-tpu-job-name: myjob" in manifest
+    assert '"name": "myjob-master"' in manifest
+    assert '"namespace": "ml"' in manifest
+    assert '"image": "img:2"' in manifest
     assert '"--model_zoo"' in manifest
+
+
+def test_k8s_service_port_follows_job_port():
+    """An explicit --port parameterizes the Service port/targetPort so
+    worker pods dialing the service DNS name reach the master
+    (ADVICE r3: it used to stay hard-coded at 50001)."""
+    from elasticdl_tpu.client.k8s_submit import build_manifests
+
+    _pod, svc = build_manifests(
+        ["--job_name", "j", "--port", "6100"], image="i")
+    assert svc["spec"]["ports"] == [{"port": 6100, "targetPort": 6100}]
+    _pod, svc = build_manifests(["--job_name", "j"], image="i")
+    assert svc["spec"]["ports"] == [
+        {"port": 50001, "targetPort": 50001}]
 
 
 def test_cli_help_and_unknown():

@@ -12,10 +12,8 @@ import sys
 import threading
 import types
 
-from elasticdl_tpu.client.k8s_renderer import (
-    parse_resource_string,
-    render_master_manifest,
-)
+from elasticdl_tpu.client.k8s_renderer import parse_resource_string
+from elasticdl_tpu.client.k8s_submit import render_manifests
 from elasticdl_tpu.master.k8s_backend import K8sWorkerBackend
 from elasticdl_tpu.master.worker_manager import WorkerManager
 
@@ -112,15 +110,15 @@ def test_pod_manifest_golden():
 
 
 def test_master_manifest_golden_and_resources():
-    text = render_master_manifest(
+    text = render_manifests(
         ["--job_name", "myjob", "--num_workers", "2"], "img:1",
         namespace="ml",
     )
-    assert "name: myjob-master" in text
-    assert "namespace: ml" in text
-    assert 'replica-type: master' in text
-    assert '"--num_workers", "2"' in text
-    assert "kind: Service" in text  # master service rendered alongside
+    assert '"name": "myjob-master"' in text
+    assert '"namespace": "ml"' in text
+    assert '"master"' in text
+    assert '"--num_workers"' in text and '"2"' in text
+    assert '"kind": "Service"' in text  # master service alongside
     assert parse_resource_string("cpu=1,memory=4Gi,google.com/tpu=8") == {
         "cpu": "1", "memory": "4Gi", "google.com/tpu": "8",
     }
@@ -357,9 +355,28 @@ def test_cli_k8s_platform_submits_via_api():
         "cpu": "3", "memory": "1Gi",
     }
     # --job_type was prepended for the master
-    assert pod["spec"]["containers"][0]["args"][:2] == [
-        "--job_type", "train",
-    ]
+    args = pod["spec"]["containers"][0]["args"]
+    assert args[:2] == ["--job_type", "train"]
+    # a cluster submission defaults the master to k8s worker PODS —
+    # without this the workers run as subprocesses inside the master
+    # pod (ADVICE r3 medium)
+    assert args[args.index("--worker_backend") + 1] == "k8s"
+
+
+def test_cli_k8s_explicit_worker_backend_wins():
+    from elasticdl_tpu.client.main import _run_job
+
+    api = FakeCoreV1Api()
+    rc = _run_job(
+        "train",
+        ["--platform", "k8s", "--job_name", "pj",
+         "--model_zoo", "mnist", "--worker_backend", "process"],
+        core_api=api,
+    )
+    assert rc == 0
+    args = api.pods["pj-master"].manifest["spec"]["containers"][0]["args"]
+    assert args.count("--worker_backend") == 1
+    assert args[args.index("--worker_backend") + 1] == "process"
 
 
 def test_cli_k8s_output_renders_manifest(tmp_path):
