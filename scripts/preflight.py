@@ -1,0 +1,106 @@
+"""Green-HEAD gate: refuse to snapshot a broken tree (VERDICT r3 #4).
+
+Runs, in order, each in a fresh subprocess with the CPU platform pinned:
+
+  1. the full test suite (pytest tests -q)
+  2. the driver's multi-chip dry run (__graft_entry__.dryrun_multichip(8))
+  3. one bench.py pass (CPU; validates the JSON contract end-to-end)
+
+Exits nonzero on the FIRST failure with the failing stage named.  Run it
+before every end-of-round snapshot — round 2 shipped a broken HEAD
+because nothing enforced this mechanically (reference analog: the CI job
+gate, scripts/validate_job_status.py + scripts/travis/run_job.sh:1-30).
+
+Usage: python scripts/preflight.py [--fast]
+  --fast skips the bench pass (suite + dryrun only, ~12 min -> ~10 min).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CPU_ENV = {
+    "ELASTICDL_TPU_PLATFORM": "cpu",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+def run_stage(name, argv, extra_env=None, timeout=2400):
+    print("[preflight] %s ..." % name, flush=True)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            argv, cwd=REPO, timeout=timeout,
+            env={**os.environ, **CPU_ENV, **(extra_env or {})},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("[preflight] FAIL %s: timed out after %ds" % (name, timeout))
+        return False, ""
+    secs = time.monotonic() - t0
+    if proc.returncode != 0:
+        print(proc.stdout[-4000:])
+        print("[preflight] FAIL %s: exit %d after %.0fs"
+              % (name, proc.returncode, secs))
+        return False, proc.stdout
+    print("[preflight] ok %s (%.0fs)" % (name, secs), flush=True)
+    return True, proc.stdout
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    fast = "--fast" in argv
+
+    ok, _ = run_stage(
+        "pytest", [sys.executable, "-m", "pytest", "tests", "-q"],
+        extra_env={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"
+        },
+    )
+    if not ok:
+        return 1
+
+    ok, _ = run_stage(
+        "dryrun_multichip(8)",
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        extra_env={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"
+        },
+        timeout=900,
+    )
+    if not ok:
+        return 1
+
+    if not fast:
+        ok, out = run_stage(
+            "bench.py (cpu)", [sys.executable, "bench.py"],
+            extra_env={"ELASTICDL_BENCH_TOTAL_BUDGET": "580"},
+            timeout=700,
+        )
+        if not ok:
+            return 1
+        line = next(
+            (ln for ln in reversed(out.strip().splitlines())
+             if ln.strip().startswith("{")), None)
+        try:
+            parsed = json.loads(line) if line else None
+        except json.JSONDecodeError:
+            parsed = None
+        if not parsed or parsed.get("value") is None:
+            print("[preflight] FAIL bench.py: no usable JSON value "
+                  "(line=%r)" % (line,))
+            return 1
+        print("[preflight] bench value: %s %s"
+              % (parsed["value"], parsed["unit"]))
+
+    print("[preflight] ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
