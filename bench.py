@@ -121,7 +121,12 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
         # honored — the wedged-relay fallback path uses batch 8.)
         batch_size, warmup, iters = min(batch_size, 16), 1, 3
 
-    spec = resnet.model_spec(variant="resnet50", num_classes=1000,
+    variant = (
+        "resnet50_s2d"
+        if os.environ.get("ELASTICDL_RESNET_S2D") == "1"
+        else "resnet50"
+    )
+    spec = resnet.model_spec(variant=variant, num_classes=1000,
                              image_size=224, learning_rate=0.1)
     trainer = CollectiveTrainer(
         spec, batch_size=batch_size, use_bf16_compute=True
@@ -181,6 +186,7 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
         "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
         "detail": {
             "platform": platform,
+            "variant": variant,
             "batch_size": batch_size,
             "iters": iters,
             "fused_steps": fused_steps,
@@ -241,13 +247,11 @@ def _run_inner(batch_size, timeout_secs, fused=0, env=None):
         ["--inner", "--batch", str(batch_size), "--fused", str(fused)],
         timeout_secs, env=env,
     )
-    for line in reversed((stdout or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), ""
-            except json.JSONDecodeError as e:
-                return None, "bad JSON: %s" % e
+    from elasticdl_tpu.utils.jsonline import last_json_line
+
+    result = last_json_line(stdout)
+    if result is not None:
+        return result, ""
     return None, reason or "no JSON output"
 
 
@@ -336,13 +340,11 @@ def _run_with_watchdog():
     if result is None:
         # Harvest the CPU stash (it has been running since t=0).
         try:
+            from elasticdl_tpu.utils.jsonline import last_json_line
+
             stdout, _ = cpu_stash.communicate(timeout=max(5, remaining()))
-            for line in reversed((stdout or "").strip().splitlines()):
-                if line.strip().startswith("{"):
-                    result = json.loads(line.strip())
-                    break
-        except (subprocess.TimeoutExpired, json.JSONDecodeError,
-                OSError) as e:
+            result = last_json_line(stdout)
+        except (subprocess.TimeoutExpired, OSError) as e:
             cpu_stash.kill()
             cpu_stash.wait()
             failures.append("cpu stash: %s" % type(e).__name__)
@@ -382,6 +384,8 @@ def _run_with_watchdog():
         and os.environ.get("ELASTICDL_BENCH_TRY_LARGE", "1") != "0"
     ):
         candidates = (
+            ("s2d", 128, 0,      # space-to-depth stem (MXU-shaped conv)
+             {"ELASTICDL_RESNET_S2D": "1", "ELASTICDL_FUSED_GN": "off"}),
             ("fusedgn", 128, 0, {"ELASTICDL_FUSED_GN": "tpu"}),
             ("batch256", 256, 0, {"ELASTICDL_FUSED_GN": "off"}),
             ("fused4", 128, 4,   # small steps-per-loop window

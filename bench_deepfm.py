@@ -187,7 +187,9 @@ def run_worker(ports, batch_size=512, vocab_size=100_000, num_fields=10,
         # measured window on this single-core box.
         with open("%s.ready.%d" % (barrier, seed), "w"):
             pass
-        deadline = time.time() + 300
+        # Longer than the coordinator's 600 s ready-deadline, so a fast
+        # worker never aborts a run the coordinator still considers live.
+        deadline = time.time() + 900
         while not os.path.exists(barrier + ".go"):
             if time.time() > deadline:
                 raise RuntimeError("barrier timeout")
@@ -211,7 +213,7 @@ def run_worker(ports, batch_size=512, vocab_size=100_000, num_fields=10,
 
 
 def run_scale(worker_counts=(1, 2, 4), num_ps=2, batch_size=512,
-              iters=30):
+              iters=60):
     """Aggregate async-PS throughput at 1..N concurrent workers."""
     results = []
     import tempfile
@@ -246,13 +248,14 @@ def run_scale(worker_counts=(1, 2, 4), num_ps=2, batch_size=512,
                 time.sleep(0.1)
             with open(barrier + ".go", "w"):
                 pass
+            from elasticdl_tpu.utils.jsonline import last_json_line
+
             reports = []
             for w in workers:
                 out, _ = w.communicate(timeout=1200)
-                for line in reversed(out.strip().splitlines()):
-                    if line.strip().startswith("{"):
-                        reports.append(json.loads(line))
-                        break
+                report = last_json_line(out)
+                if report is not None:
+                    reports.append(report)
             if len(reports) < n:
                 raise RuntimeError(
                     "only %d/%d workers reported" % (len(reports), n))
@@ -414,15 +417,16 @@ def _run_with_watchdog(timeout_secs=None):
         )
     stderr_tail = ""
     try:
+        from elasticdl_tpu.utils.jsonline import last_json_line
+
         proc = subprocess.run(
             [sys.executable, __file__, "--inner"],
             capture_output=True, text=True, timeout=timeout_secs,
         )
         stderr_tail = (proc.stderr or "")[-300:]
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                return json.loads(line)
+        result = last_json_line(proc.stdout)
+        if result is not None:
+            return result
         reason = "no JSON output from measurement subprocess"
     except subprocess.TimeoutExpired:
         reason = "measurement timed out after %ds" % timeout_secs
@@ -467,7 +471,7 @@ if __name__ == "__main__":
                 "ELASTICDL_SCALE_WORKERS", "1,2,4,8").split(",")
         )
         run_scale(worker_counts=counts,
-                  iters=_argv_int("--iters", 30))
+                  iters=_argv_int("--iters", 60))
     elif "--inner" in sys.argv:
         print(json.dumps(run_bench()))
     else:

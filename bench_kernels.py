@@ -1,0 +1,115 @@
+"""Kernel A/B matrix on the real chip (VERDICT r3 #2/#5).
+
+Runs the promoted-kernel candidates as watchdog'd subprocesses, each
+with its own timeout and the shared persistent compilation cache, and
+prints one JSON line with every measured row.  Configs:
+
+ResNet-50 (bench.py --inner, batch 128, img/s):
+  baseline      XLA GroupNorm, 7x7 stem
+  fusedgn       Pallas fused GroupNorm(+ReLU)
+  s2d           space-to-depth stem (4x4/1 conv on C=12)
+  s2d+fusedgn   both
+
+Flagship LM (bench_transformer.py, 436M params, tok/s):
+  default       Pallas flash fwd+bwd, full per-layer remat
+  xla_bwd       flash fwd + XLA block-recompute bwd
+  remat_attn    Pallas flash fwd+bwd, remat="attn" (no flash recompute)
+
+Use: run with a healthy relay; results go to BENCHMARKS.md and winners
+become defaults.  A wedged relay costs one failed probe (<=90 s), not
+the whole matrix.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+RESNET_CONFIGS = [
+    ("baseline", {"ELASTICDL_FUSED_GN": "off"}),
+    ("fusedgn", {"ELASTICDL_FUSED_GN": "tpu"}),
+    ("s2d", {"ELASTICDL_FUSED_GN": "off", "ELASTICDL_RESNET_S2D": "1"}),
+    ("s2d+fusedgn",
+     {"ELASTICDL_FUSED_GN": "tpu", "ELASTICDL_RESNET_S2D": "1"}),
+]
+
+LM_CONFIGS = [
+    ("default", {}),
+    ("xla_bwd", {"ELASTICDL_FLASH_BWD": "xla"}),
+    ("remat_attn", {"ELASTICDL_BENCH_REMAT": "attn"}),
+]
+
+
+def _run(argv, env, timeout):
+    """Returns (parsed_json|None, reason, returncode|None)."""
+    from elasticdl_tpu.utils.jsonline import last_json_line
+
+    try:
+        proc = subprocess.run(
+            [sys.executable] + argv, capture_output=True, text=True,
+            timeout=timeout, env={**os.environ, **env}, cwd=HERE,
+        )
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        marks = [ln for ln in (stderr or "").splitlines()
+                 if ln.startswith("BENCHMARK-MARK ")]
+        return None, "timeout %ds at %s" % (
+            timeout, marks[-1].split(" ", 1)[1] if marks else "?"), None
+    result = last_json_line(proc.stdout)
+    if result is not None:
+        return result, "", proc.returncode
+    return None, "no JSON (exit %d); stderr: %s" % (
+        proc.returncode, (proc.stderr or "")[-200:]), proc.returncode
+
+
+def main():
+    per_cfg = int(os.environ.get("ELASTICDL_AB_TIMEOUT", "420"))
+    rows = {"resnet": {}, "lm": {}}
+
+    _, reason, rc = _run(["bench.py", "--probe"], {}, 90)
+    # --probe prints PROBE-OK (not JSON) and exits 0 iff the relay
+    # answered — the exit status is the health signal.
+    if rc != 0:
+        print(json.dumps({"error": "relay probe failed: %s" % reason}))
+        return 1
+
+    for name, env in RESNET_CONFIGS:
+        t0 = time.monotonic()
+        res, reason, _rc = _run(
+            ["bench.py", "--inner", "--batch", "128"], env, per_cfg)
+        rows["resnet"][name] = (
+            {"img_per_sec": res["value"],
+             "ms_per_step": res["detail"]["ms_per_step"],
+             "mfu": res["detail"]["mfu_estimate"],
+             "compile_secs": res["detail"]["compile_secs"]}
+            if res else {"error": reason}
+        )
+        print("resnet/%s: %s (%.0fs)" % (
+            name, rows["resnet"][name], time.monotonic() - t0),
+            file=sys.stderr, flush=True)
+
+    for name, env in LM_CONFIGS:
+        t0 = time.monotonic()
+        res, reason, _rc = _run(["bench_transformer.py"], env, per_cfg)
+        rows["lm"][name] = (
+            {"tok_per_sec": res["value"],
+             "ms_per_step": res["detail"]["ms_per_step"],
+             "mfu": res["detail"]["mfu_estimate"],
+             "compile_secs": res["detail"]["compile_secs"]}
+            if res else {"error": reason}
+        )
+        print("lm/%s: %s (%.0fs)" % (
+            name, rows["lm"][name], time.monotonic() - t0),
+            file=sys.stderr, flush=True)
+
+    print(json.dumps({"metric": "kernel_ab_matrix", "rows": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,10 +54,10 @@ def run_bench(warmup=2, iters=10):
         dim, layers, seq, batch, iters_ = 256, 4, 256, 2, 2
 
     # remat: "dots" saves matmul outputs (fewer re-FLOPs, more memory),
-    # anything else full per-layer remat.
-    remat = (
-        "dots" if os.environ.get("ELASTICDL_BENCH_REMAT") == "dots"
-        else True
+    # "attn" saves only attention outputs (skips recomputing flash in
+    # the backward), anything else full per-layer remat.
+    remat = {"dots": "dots", "attn": "attn"}.get(
+        os.environ.get("ELASTICDL_BENCH_REMAT", ""), True
     )
     cfg = tfm.TransformerConfig(
         vocab_size=VOCAB, dim=dim, num_heads=HEADS, num_layers=layers,
@@ -122,6 +122,8 @@ def run_bench(warmup=2, iters=10):
             "compile_secs": round(compile_secs, 1),
             "last_loss": last_loss,
             "flash": os.environ.get("ELASTICDL_FLASH", "auto"),
+            "flash_bwd": os.environ.get("ELASTICDL_FLASH_BWD", "pallas"),
+            "remat": str(remat),
         },
     }
 

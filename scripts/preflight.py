@@ -84,16 +84,13 @@ def main(argv=None):
         )
         if not ok:
             return 1
-        line = next(
-            (ln for ln in reversed(out.strip().splitlines())
-             if ln.strip().startswith("{")), None)
-        try:
-            parsed = json.loads(line) if line else None
-        except json.JSONDecodeError:
-            parsed = None
+        sys.path.insert(0, REPO)
+        from elasticdl_tpu.utils.jsonline import last_json_line
+
+        parsed = last_json_line(out)
         if not parsed or parsed.get("value") is None:
             print("[preflight] FAIL bench.py: no usable JSON value "
-                  "(line=%r)" % (line,))
+                  "(tail=%r)" % out.strip().splitlines()[-3:])
             return 1
         print("[preflight] bench value: %s %s"
               % (parsed["value"], parsed["unit"]))

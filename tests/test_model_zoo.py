@@ -40,6 +40,41 @@ def test_mobilenetv2_param_count_near_reference():
     assert 1.8e6 < count < 2.8e6, count
 
 
+def test_resnet_s2d_stem():
+    """Space-to-depth stem (MXU-shaped first conv, VERDICT r3 #5):
+    the transform is an exact invertible reshuffle, the s2d model's
+    feature maps keep the standard resnet50 shapes from the pool down
+    (so every later layer is identical), and a step trains."""
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.models import resnet
+
+    x = np.arange(2 * 8 * 8 * 3, dtype=np.float32).reshape(2, 8, 8, 3)
+    s = resnet.space_to_depth(jnp.asarray(x), 2)
+    assert s.shape == (2, 4, 4, 12)
+    # block (i,j) of the input is channel-sliced intact: position
+    # [b, h, w, (di*2+dj)*3 + c] == input [b, 2h+di, 2w+dj, c]
+    np.testing.assert_array_equal(
+        np.asarray(s)[0, 1, 2, :3], x[0, 2, 4, :3])
+    np.testing.assert_array_equal(
+        np.asarray(s)[0, 1, 2, 9:], x[0, 3, 5, :3])
+
+    spec = resnet.model_spec(variant="resnet50_s2d", num_classes=10,
+                             image_size=64, learning_rate=0.1)
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    stem = params["Conv_0"]["kernel"]
+    assert stem.shape == (4, 4, 12, 64)  # vs (7, 7, 3, 64) baseline
+    logits = spec.apply_fn(params, np.zeros((2, 64, 64, 3), np.float32),
+                           True)
+    assert logits.shape == (2, 10)
+    trainer = CollectiveTrainer(spec, batch_size=4)
+    xs = np.random.RandomState(0).rand(4, 64, 64, 3).astype(np.float32)
+    ys = np.arange(4, dtype=np.int32) % 10
+    loss, _ = trainer.train_minibatch(xs, ys)
+    assert np.isfinite(loss)
+
+
 def test_mobilenetv2_trains():
     spec = mobilenet.model_spec(learning_rate=0.01)
     trainer = CollectiveTrainer(spec, batch_size=8)
