@@ -11,9 +11,13 @@ ResNet-50 (bench.py --inner, batch 128, img/s):
   s2d+fusedgn   both
 
 Flagship LM (bench_transformer.py, 436M params, tok/s):
-  default       Pallas flash fwd+bwd, full per-layer remat
-  xla_bwd       flash fwd + XLA block-recompute bwd
-  remat_attn    Pallas flash fwd+bwd, remat="attn" (no flash recompute)
+  default           Pallas flash fwd+bwd, full per-layer remat
+  xla_bwd           flash fwd + XLA block-recompute bwd
+  remat_attn        Pallas flash fwd+bwd, remat="attn" (no flash
+                    recompute in the backward)
+  chunked_xent      no-[B,T,V]-logits loss (T-chunked ln_f+head+xent)
+  attn+chunked      remat="attn" + chunked loss
+  attn+chunked_b16  same at batch 16 (memory freed by the above)
 
 Use: run with a healthy relay; results go to BENCHMARKS.md and winners
 become defaults.  A wedged relay costs one failed probe (<=90 s), not
@@ -40,6 +44,12 @@ LM_CONFIGS = [
     ("default", {}),
     ("xla_bwd", {"ELASTICDL_FLASH_BWD": "xla"}),
     ("remat_attn", {"ELASTICDL_BENCH_REMAT": "attn"}),
+    ("chunked_xent", {"ELASTICDL_BENCH_CHUNKED_XENT": "512"}),
+    ("attn+chunked", {"ELASTICDL_BENCH_REMAT": "attn",
+                      "ELASTICDL_BENCH_CHUNKED_XENT": "512"}),
+    ("attn+chunked_b16", {"ELASTICDL_BENCH_REMAT": "attn",
+                          "ELASTICDL_BENCH_CHUNKED_XENT": "512",
+                          "ELASTICDL_BENCH_BATCH": "16"}),
 ]
 
 

@@ -74,7 +74,17 @@ def run_bench(warmup=2, iters=10):
         0, VOCAB, size=(batch, seq)
     ).astype(np.int32))
 
+    # Chunked cross-entropy: never materialize the [B, T, V] logits
+    # (~2 GB f32 at this config) — ln_f+head+xent run per T-chunk under
+    # jax.checkpoint (models/transformer.py next_token_loss_chunked).
+    xent_chunk = int(os.environ.get("ELASTICDL_BENCH_CHUNKED_XENT", "0"))
+
     def loss_fn(p):
+        if xent_chunk:
+            hidden, _aux = tfm.forward_hidden(p, tokens, cfg, mesh=None)
+            return tfm.next_token_loss_chunked(
+                p, hidden, tokens, cfg, chunk=xent_chunk
+            ).mean()
         logits = tfm.forward(p, tokens, cfg, mesh=None)
         return tfm.next_token_loss(logits, tokens).mean()
 
@@ -124,6 +134,7 @@ def run_bench(warmup=2, iters=10):
             "flash": os.environ.get("ELASTICDL_FLASH", "auto"),
             "flash_bwd": os.environ.get("ELASTICDL_FLASH_BWD", "pallas"),
             "remat": str(remat),
+            "xent_chunk": xent_chunk,
         },
     }
 

@@ -349,11 +349,14 @@ def _head(params, x, cfg):
     return (x @ head).astype(jnp.float32)
 
 
-def forward(params, tokens, cfg, mesh=None, return_aux=False):
-    """tokens: [B, T] int32 -> logits [B, T, V].
+def forward_hidden(params, tokens, cfg, mesh=None):
+    """tokens: [B, T] int32 -> (final hidden [B, T, dim] BEFORE the
+    ln_f/head, mean per-layer MoE aux).
 
-    With ``return_aux`` (training an MoE), also returns the mean
-    per-layer load-balance loss for the spec's loss_fn to add.
+    Pair with :func:`next_token_loss_chunked` to train without ever
+    materializing the [B, T, V] logits tensor (at the flagship config
+    that tensor is ~2 GB in f32 — a pure HBM-bandwidth tax the chunked
+    loss removes).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     act_spec = P("dp", "sp", None)
@@ -380,9 +383,19 @@ def forward(params, tokens, cfg, mesh=None, return_aux=False):
     elif cfg.remat:
         layer = jax.checkpoint(layer)
     x, aux_per_layer = jax.lax.scan(layer, x, params["layers"])
+    return x, aux_per_layer.mean()
+
+
+def forward(params, tokens, cfg, mesh=None, return_aux=False):
+    """tokens: [B, T] int32 -> logits [B, T, V].
+
+    With ``return_aux`` (training an MoE), also returns the mean
+    per-layer load-balance loss for the spec's loss_fn to add.
+    """
+    x, aux = forward_hidden(params, tokens, cfg, mesh=mesh)
     logits = _head(params, x, cfg)
     if return_aux:
-        return logits, aux_per_layer.mean()
+        return logits, aux
     return logits
 
 
@@ -476,6 +489,46 @@ def next_token_loss(logits, tokens):
         logits, targets
     )
     return per_tok.mean(axis=-1)
+
+
+def next_token_loss_chunked(params, hidden, tokens, cfg, chunk=512):
+    """Next-token xent from :func:`forward_hidden` output WITHOUT a
+    [B, T, V] logits tensor: the ln_f + head matmul + softmax-xent run
+    per T-chunk under ``jax.checkpoint`` inside a scan, so peak live
+    logits are [B, chunk, V] in both directions (the backward
+    recomputes each chunk's logits).  Numerically identical (f32
+    accumulation) to ``next_token_loss(_head(hidden))``.  Returns the
+    per-example mean, matching :func:`next_token_loss`.
+    """
+    b, t, _ = hidden.shape
+    h = hidden[:, :-1]
+    targets = tokens[:, 1:]
+    n = t - 1
+    pad = (-n) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    valid = jnp.arange(n + pad) < n
+    nc = (n + pad) // chunk
+    h = h.reshape(b, nc, chunk, h.shape[-1]).transpose(1, 0, 2, 3)
+    tg = targets.reshape(b, nc, chunk).transpose(1, 0, 2)
+    mk = valid.reshape(nc, chunk)
+
+    @jax.checkpoint
+    def chunk_sum(h_c, t_c, m_c):
+        logits = _head(params, h_c, cfg)              # [B, chunk, V]
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, t_c
+        )
+        return (per_tok * m_c[None, :]).sum(axis=-1)  # [B]
+
+    def body(acc, xs):
+        return acc + chunk_sum(*xs), None
+
+    total, _ = jax.lax.scan(
+        body, jnp.zeros((b,), jnp.float32), (h, tg, mk)
+    )
+    return total / n
 
 
 # -- zoo contract -------------------------------------------------------------

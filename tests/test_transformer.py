@@ -52,6 +52,34 @@ def test_sharded_forward_matches_single_device(params, axes):
     np.testing.assert_allclose(ref, np.asarray(out), rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.parametrize("chunk", [8, 12, 32])
+def test_chunked_xent_matches_dense(params, chunk):
+    """next_token_loss_chunked == next_token_loss(_head(hidden)) in
+    value AND gradients — incl. chunk=12 (T-1=31 pads to 36) and
+    chunk=32 (single padded chunk).  This is the no-[B,T,V]-logits
+    training path the flagship LM bench uses."""
+    tokens = make_tokens(b=2, t=32, seed=3)
+
+    def dense_loss(p):
+        logits = tfm.forward(p, tokens, CFG)
+        return tfm.next_token_loss(logits, tokens).mean()
+
+    def chunked_loss(p):
+        hidden, _aux = tfm.forward_hidden(p, tokens, CFG)
+        return tfm.next_token_loss_chunked(
+            p, hidden, tokens, CFG, chunk=chunk
+        ).mean()
+
+    l0, g0 = jax.value_and_grad(dense_loss)(params)
+    l1, g1 = jax.jit(jax.value_and_grad(chunked_loss))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-6),
+        g0, g1,
+    )
+
+
 @pytest.mark.parametrize("remat", [True, "attn", "dots"])
 def test_remat_policies_preserve_gradients(params, remat):
     tokens = make_tokens(b=2, t=16)
