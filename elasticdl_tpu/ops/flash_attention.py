@@ -27,9 +27,9 @@ Layout: [batch, heads, seq, head_dim].  The caller-facing block sizes
 are a friendliness contract (seq divisible by them, 128-lane block_k);
 the kernel chooses its own internal tiling (up to 512-wide q blocks and
 K/V major tiles) to amortize per-grid-step overhead.  `flash_attention`
-falls back to the reference implementation for unfriendly shapes.  Mode selection (the
-relay in this image cannot compile Pallas — see PARITY.md):
-``ELASTICDL_FLASH=auto`` (default: compiled kernel on TPU, jnp
+falls back to the reference implementation for unfriendly shapes.
+Mode selection: ``ELASTICDL_FLASH=auto`` (default: compiled kernel on
+TPU — validated on the real chip 2026-07-29, see BENCHMARKS.md; jnp
 elsewhere), ``interpret`` (Pallas interpret mode, for tests), ``off``.
 """
 
