@@ -49,7 +49,8 @@ def _signature(tree):
 
 def export_servable(export_dir, apply_fn, params, example_input,
                     model_name="", version=0, embeddings=None,
-                    dense_overrides=None, platforms=("cpu", "tpu")):
+                    dense_overrides=None, platforms=("cpu", "tpu"),
+                    polymorphic_batch=True):
     """Write a standalone servable export.
 
     apply_fn: (params_pytree, inputs) -> outputs (inference mode —
@@ -58,6 +59,12 @@ def export_servable(export_dir, apply_fn, params, example_input,
     shape/dtype matter).  embeddings: {table: (ids, values)} from the
     PS checkpoint merge.  dense_overrides: {flat_name: ndarray} taking
     precedence over ``params`` (the PS checkpoint's newer dense state).
+
+    With ``polymorphic_batch`` (default) the leading dim of every input
+    leaf is exported SYMBOLIC, so the servable accepts any batch size —
+    a server can't fix its clients' batch at training time.  Falls back
+    to the example's fixed shapes if symbolic export fails (e.g. a
+    model whose lowering needs concrete dims).
     """
     import jax
     from jax import export as jax_export
@@ -86,9 +93,35 @@ def export_servable(export_dir, apply_fn, params, example_input,
         lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
         example_input,
     )
-    exported = jax_export.export(
-        jax.jit(serve_fn), platforms=list(platforms)
-    )(flat_specs, input_specs)
+    poly = False
+    if polymorphic_batch:
+        try:
+            # params stay concrete (None); every input leaf of rank >=1
+            # gets a shared symbolic leading dim "b" (rank-0 leaves —
+            # scalar thresholds/temperatures — stay concrete; one
+            # scalar must not force the whole export monomorphic).
+            leaf_specs = jax.tree_util.tree_map(
+                lambda s: None if len(s.shape) == 0 else "b, ...",
+                input_specs,
+            )
+            poly_specs = jax_export.symbolic_args_specs(
+                (flat_specs, input_specs), (None, leaf_specs)
+            )
+            exported = jax_export.export(
+                jax.jit(serve_fn), platforms=list(platforms)
+            )(*poly_specs)
+            poly = True
+        except Exception as e:  # noqa: BLE001 — any lowering failure
+            logger.warning(
+                "polymorphic-batch export failed (%s); falling back to "
+                "the example's fixed shapes.  NOTE: if the fixed-shape "
+                "export below also fails, the error is in the model "
+                "function itself, not batch polymorphism.", e,
+            )
+    if not poly:
+        exported = jax_export.export(
+            jax.jit(serve_fn), platforms=list(platforms)
+        )(flat_specs, input_specs)
 
     payload = dict(flat)
     table_names = []
@@ -104,6 +137,7 @@ def export_servable(export_dir, apply_fn, params, example_input,
         "format": FORMAT,
         "model_name": model_name,
         "version": version,
+        "polymorphic_batch": poly,
         "platforms": list(platforms),
         "parameters": sorted(flat),
         "embedding_tables": sorted(table_names),

@@ -1,0 +1,166 @@
+"""Minimal model server over a servable export — the TF-Serving role.
+
+The reference's deployment story is "export a SavedModel, point TF
+Serving (or EAS) at it" (model_handler.py:242-269, docs SQLFlow
+integration).  The TPU-native equivalent: ``serving/export.py`` writes
+StableHLO + npz, and THIS module serves it over the same REST surface
+TF Serving exposes, so clients migrating from the reference keep their
+request shape:
+
+  GET  /v1/models/<name>            -> model metadata (manifest)
+  POST /v1/models/<name>:predict    -> {"predictions": [...]}
+       body {"instances": [...]}          batched single-input models
+       body {"inputs": {name: [...]}}     dict-input models
+  POST /v1/models/<name>:lookup     -> {"vectors": [...]}
+       body {"table": t, "ids": [...]}    PS-trained embedding tables
+
+Stdlib-only HTTP (ThreadingHTTPServer); jax is needed only to execute
+the StableHLO — the loader stays framework-free.
+
+Run: python -m elasticdl_tpu.serving.server --export_dir D [--port P]
+"""
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from elasticdl_tpu.serving.loader import load_servable
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _leaf_dtypes(signature):
+    """Flatten a manifest input_signature into {path_or_None: dtype}."""
+    if isinstance(signature, dict) and set(signature) >= {"shape",
+                                                          "dtype"}:
+        return {None: signature["dtype"]}
+    if isinstance(signature, dict):
+        out = {}
+        for key, sub in signature.items():
+            for path, dtype in _leaf_dtypes(sub).items():
+                out[key if path is None else "%s/%s" % (key, path)] = (
+                    dtype
+                )
+        return out
+    return {None: "float32"}
+
+
+class ModelEndpoint:
+    """One loaded servable + request/response marshalling."""
+
+    def __init__(self, export_dir, name=None):
+        self.model = load_servable(export_dir)
+        self.name = name or self.model.manifest.get("model_name") or (
+            "model"
+        )
+        self._dtypes = _leaf_dtypes(
+            self.model.manifest.get("input_signature", {})
+        )
+        self._lock = threading.Lock()  # jax.export call is not
+        # documented thread-safe; serialize execution, marshal outside
+
+    def metadata(self):
+        return {
+            "model_version_status": [{
+                "version": str(self.model.manifest.get("version", 0)),
+                "state": "AVAILABLE",
+            }],
+            "metadata": self.model.manifest,
+        }
+
+    def predict(self, body):
+        if "instances" in body:
+            dtype = self._dtypes.get(None, "float32")
+            inputs = np.asarray(body["instances"], dtype=dtype)
+        elif "inputs" in body:
+            inputs = {
+                key: np.asarray(
+                    value, dtype=self._dtypes.get(key, "float32")
+                )
+                for key, value in body["inputs"].items()
+            }
+        else:
+            raise ValueError("body needs 'instances' or 'inputs'")
+        with self._lock:
+            outputs = self.model.predict(inputs)
+        return {"predictions": np.asarray(outputs).tolist()}
+
+    def lookup(self, body):
+        vectors = self.model.lookup_embedding(
+            body["table"], np.asarray(body["ids"], np.int64)
+        )
+        return {"vectors": vectors.tolist()}
+
+
+def build_server(endpoint, port=0, host="127.0.0.1"):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.debug("http: " + fmt, *args)
+
+        def _reply(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v1/models/%s" % endpoint.name:
+                self._reply(200, endpoint.metadata())
+            else:
+                self._reply(404, {"error": "unknown path %r" % self.path})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                return self._reply(400, {"error": "bad JSON: %s" % e})
+            route = {
+                "/v1/models/%s:predict" % endpoint.name:
+                    endpoint.predict,
+                "/v1/models/%s:lookup" % endpoint.name:
+                    endpoint.lookup,
+            }.get(self.path)
+            if route is None:
+                return self._reply(
+                    404, {"error": "unknown path %r" % self.path})
+            try:
+                self._reply(200, route(body))
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("elasticdl-tpu model server")
+    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--model_name", default=None)
+    parser.add_argument("--port", type=int, default=8501)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+    endpoint = ModelEndpoint(args.export_dir, name=args.model_name)
+    server = build_server(endpoint, port=args.port, host=args.host)
+    logger.info(
+        "serving model %r on %s:%d (predict: POST "
+        "/v1/models/%s:predict)",
+        endpoint.name, args.host, server.server_address[1],
+        endpoint.name,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

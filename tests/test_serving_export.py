@@ -8,6 +8,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from elasticdl_tpu.models import mnist
 from elasticdl_tpu.models.callbacks import ModelExporter
@@ -137,6 +138,95 @@ def test_embedding_lookup(tmp_path):
     np.testing.assert_array_equal(rows[0], [4, 5, 6, 7])
     np.testing.assert_array_equal(rows[1], [0, 0, 0, 0])  # unknown id
     np.testing.assert_array_equal(rows[2], [0, 1, 2, 3])
+
+
+def test_polymorphic_batch_export(tmp_path):
+    """The servable accepts ANY batch size (symbolic leading dim), and
+    a scalar aux input does not force the export monomorphic."""
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.loader import load_servable
+
+    manifest = export_servable(
+        str(tmp_path / "e"),
+        lambda p, x: x["v"] @ p["w"] * x["temp"],
+        {"w": np.arange(8, dtype=np.float32).reshape(4, 2)},
+        {"v": np.zeros((1, 4), np.float32),
+         "temp": np.float32(1.0)},  # rank-0 leaf stays concrete
+        platforms=("cpu",),
+    )
+    assert manifest["polymorphic_batch"] is True
+    model = load_servable(str(tmp_path / "e"))
+    for batch in (1, 3, 7):  # != the example's batch of 1
+        out = np.asarray(model.predict(
+            {"v": np.ones((batch, 4), np.float32),
+             "temp": np.float32(2.0)}
+        ))
+        assert out.shape == (batch, 2)
+        np.testing.assert_allclose(out[0], [24.0, 32.0])
+
+
+def test_model_server_rest_surface(tmp_path):
+    """The TF-Serving-role HTTP server over a servable export:
+    metadata, :predict (instances), :lookup, and error paths — the
+    REST shape clients of the reference's TF Serving deployment keep
+    (model_handler.py:242-269)."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.server import ModelEndpoint, build_server
+
+    export_servable(
+        str(tmp_path / "e"),
+        lambda p, x: x @ p["w"],
+        {"w": np.arange(8, dtype=np.float32).reshape(4, 2)},
+        np.zeros((1, 4), np.float32),
+        model_name="lin",
+        embeddings={"users": (np.array([5, 9]),
+                              np.arange(8, dtype=np.float32)
+                              .reshape(2, 4))},
+        platforms=("cpu",),
+    )
+    server = build_server(ModelEndpoint(str(tmp_path / "e")), port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = "http://127.0.0.1:%d/v1/models/lin" % port
+
+    def call(path, payload=None):
+        req = urllib.request.Request(
+            base + path,
+            data=None if payload is None
+            else _json.dumps(payload).encode(),
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return _json.loads(resp.read())
+
+    try:
+        meta = call("")
+        assert meta["model_version_status"][0]["state"] == "AVAILABLE"
+        assert meta["metadata"]["model_name"] == "lin"
+
+        out = call(":predict", {"instances": [[1, 1, 1, 1],
+                                              [0, 1, 0, 0]]})
+        np.testing.assert_allclose(out["predictions"],
+                                   [[12.0, 16.0], [2.0, 3.0]])
+
+        vecs = call(":lookup", {"table": "users", "ids": [9, 7]})
+        np.testing.assert_allclose(vecs["vectors"],
+                                   [[4, 5, 6, 7], [0, 0, 0, 0]])
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            call(":predict", {"wrong_key": []})
+        assert err.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            call(":nope", {})
+        assert err.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
 
 
 def test_embedding_lookup_large_table_is_o_batch(tmp_path):
