@@ -2,6 +2,8 @@
 
 Subcommands:
   zoo init | zoo build | zoo push     — model-zoo project tooling
+  serve                               — HTTP model server over a
+                                      servable export (serving/server)
   train | evaluate | predict          — submit a job:
       --platform local  (default)     run the master (and its managed
                                       worker/PS processes) on this host
@@ -193,6 +195,11 @@ def build_parser():
             help="%s job (plus all master flags)" % job,
         )
         _add_job_args(p)
+    sub.add_parser(
+        "serve", add_help=False,
+        help="serve a servable export over HTTP "
+             "(--export_dir DIR [--port P] [--model_name N])",
+    )
     return parser
 
 
@@ -205,6 +212,10 @@ def main(argv=None):
     command = argv[0]
     if command in ("train", "evaluate", "predict"):
         return _run_job(command, argv[1:])
+    if command == "serve":
+        from elasticdl_tpu.serving.server import main as serve_main
+
+        return serve_main(argv[1:])
     args = parser.parse_args(argv)
     if args.command == "zoo":
         if args.zoo_command == "init":

@@ -94,6 +94,20 @@ def export_servable(export_dir, apply_fn, params, example_input,
         example_input,
     )
     poly = False
+    lead_dims = {
+        s.shape[0] for s in jax.tree_util.tree_leaves(input_specs)
+        if len(s.shape) >= 1
+    }
+    if polymorphic_batch and len(lead_dims) != 1:
+        # Rank>=1 leaves disagree on their leading dim, so a shared
+        # batch symbol would mis-describe the model (the export would
+        # SUCCEED but reject the very shapes it was built from).  Keep
+        # concrete shapes instead of guessing which inputs batch.
+        logger.info(
+            "input leading dims %s are not uniform; exporting with "
+            "fixed shapes", sorted(lead_dims),
+        )
+        polymorphic_batch = False
     if polymorphic_batch:
         try:
             # params stay concrete (None); every input leaf of rank >=1
@@ -133,6 +147,22 @@ def export_servable(export_dir, apply_fn, params, example_input,
         np.savez(f, **payload)
     with open(os.path.join(export_dir, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
+    signature = _signature(example_input)
+    if poly:
+        # Truthful metadata: the leading dim is symbolic, not the
+        # example's batch — record it as null.
+        import jax as _jax
+
+        def _free_batch(spec):
+            if isinstance(spec, dict) and "shape" in spec:
+                if spec["shape"]:
+                    spec = dict(spec, shape=[None] + spec["shape"][1:])
+            return spec
+
+        signature = _jax.tree_util.tree_map(
+            _free_batch, signature,
+            is_leaf=lambda s: isinstance(s, dict) and "shape" in s,
+        )
     manifest = {
         "format": FORMAT,
         "model_name": model_name,
@@ -141,7 +171,7 @@ def export_servable(export_dir, apply_fn, params, example_input,
         "platforms": list(platforms),
         "parameters": sorted(flat),
         "embedding_tables": sorted(table_names),
-        "input_signature": _signature(example_input),
+        "input_signature": signature,
         "loader": "elasticdl_tpu.serving.loader:load_servable",
     }
     with open(os.path.join(export_dir, "manifest.json"), "w") as f:

@@ -22,6 +22,7 @@ Run: python -m elasticdl_tpu.serving.server --export_dir D [--port P]
 
 import argparse
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -34,19 +35,31 @@ logger = get_logger(__name__)
 
 
 def _leaf_dtypes(signature):
-    """Flatten a manifest input_signature into {path_or_None: dtype}."""
+    """Manifest input_signature -> {key_or_None: dtype}.
+
+    The REST surface supports a single array ({"instances": ...}) or a
+    FLAT dict of arrays ({"inputs": {name: ...}}); deeper pytree inputs
+    need the Python loader directly.
+    """
     if isinstance(signature, dict) and set(signature) >= {"shape",
                                                           "dtype"}:
         return {None: signature["dtype"]}
     if isinstance(signature, dict):
-        out = {}
-        for key, sub in signature.items():
-            for path, dtype in _leaf_dtypes(sub).items():
-                out[key if path is None else "%s/%s" % (key, path)] = (
-                    dtype
-                )
-        return out
+        return {
+            key: (sub.get("dtype", "float32")
+                  if isinstance(sub, dict) else "float32")
+            for key, sub in signature.items()
+        }
     return {None: "float32"}
+
+
+def _jsonable(outputs):
+    """Model output pytree (array | tuple | list | dict) -> JSON."""
+    if isinstance(outputs, dict):
+        return {k: _jsonable(v) for k, v in outputs.items()}
+    if isinstance(outputs, (list, tuple)):
+        return [_jsonable(v) for v in outputs]
+    return np.asarray(outputs).tolist()
 
 
 class ModelEndpoint:
@@ -87,7 +100,7 @@ class ModelEndpoint:
             raise ValueError("body needs 'instances' or 'inputs'")
         with self._lock:
             outputs = self.model.predict(inputs)
-        return {"predictions": np.asarray(outputs).tolist()}
+        return {"predictions": _jsonable(outputs)}
 
     def lookup(self, body):
         vectors = self.model.lookup_embedding(
@@ -118,8 +131,10 @@ def build_server(endpoint, port=0, host="127.0.0.1"):
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
             try:
+                # ValueError covers JSONDecodeError AND the
+                # UnicodeDecodeError a non-UTF-8 body raises.
                 body = json.loads(self.rfile.read(length) or b"{}")
-            except json.JSONDecodeError as e:
+            except ValueError as e:
                 return self._reply(400, {"error": "bad JSON: %s" % e})
             route = {
                 "/v1/models/%s:predict" % endpoint.name:
@@ -134,6 +149,12 @@ def build_server(endpoint, port=0, host="127.0.0.1"):
                 self._reply(200, route(body))
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — runtime failures
+                # (e.g. an XLA error) must return 500, not crash the
+                # handler thread and drop the connection.
+                logger.warning("request failed: %s", e)
+                self._reply(500, {"error": "%s: %s"
+                                  % (type(e).__name__, e)})
 
     return ThreadingHTTPServer((host, port), Handler)
 
@@ -145,6 +166,15 @@ def main(argv=None):
     parser.add_argument("--port", type=int, default=8501)
     parser.add_argument("--host", default="0.0.0.0")
     args = parser.parse_args(argv)
+    if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+        # The session sitecustomize can pin another backend via
+        # jax.config (overriding JAX_PLATFORMS); honor the explicit
+        # platform request BEFORE the first predict initializes jax.
+        import jax
+
+        jax.config.update(
+            "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+        )
     endpoint = ModelEndpoint(args.export_dir, name=args.model_name)
     server = build_server(endpoint, port=args.port, host=args.host)
     logger.info(

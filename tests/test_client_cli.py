@@ -66,3 +66,59 @@ def test_k8s_service_port_follows_job_port():
 
 def test_cli_help_and_unknown():
     assert main([]) == 1
+
+
+def test_cli_serve_end_to_end(tmp_path):
+    """`elasticdl-tpu serve` over a fresh export: the full
+    export -> serve -> predict loop through the CLI."""
+    import json
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.utils.grpc_utils import find_free_port
+
+    export_servable(
+        str(tmp_path / "e"),
+        lambda p, x: x @ p["w"],
+        {"w": np.eye(3, dtype=np.float32) * 2.0},
+        np.zeros((1, 3), np.float32),
+        model_name="srv",
+        platforms=("cpu",),
+    )
+    port = find_free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticdl_tpu.client.main", "serve",
+         "--export_dir", str(tmp_path / "e"), "--port", str(port),
+         "--host", "127.0.0.1"],
+        env={**os.environ, "ELASTICDL_TPU_PLATFORM": "cpu",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    base = "http://127.0.0.1:%d/v1/models/srv" % port
+    try:
+        deadline = time.time() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(base, timeout=5) as resp:
+                    meta = json.loads(resp.read())
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                assert proc.poll() is None, "server died"
+                time.sleep(0.3)
+        assert meta["metadata"]["model_name"] == "srv"
+        req = urllib.request.Request(
+            base + ":predict",
+            data=json.dumps({"instances": [[1, 2, 3]]}).encode(),
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        np.testing.assert_allclose(out["predictions"], [[2.0, 4.0, 6.0]])
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

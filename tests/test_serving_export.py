@@ -63,9 +63,11 @@ jax.config.update("jax_platforms", "cpu")
 from elasticdl_tpu.serving.loader import load_servable
 
 model = load_servable(%(export_dir)r)
-x = np.zeros(
-    model.manifest["input_signature"]["shape"], np.float32
-)
+shape = [
+    8 if d is None else d  # polymorphic batch: caller picks the batch
+    for d in model.manifest["input_signature"]["shape"]
+]
+x = np.zeros(shape, np.float32)
 out = np.asarray(model.predict(x))
 banned = [
     m for m in sys.modules
@@ -155,6 +157,10 @@ def test_polymorphic_batch_export(tmp_path):
         platforms=("cpu",),
     )
     assert manifest["polymorphic_batch"] is True
+    # metadata tells the truth: the batch dim is free, not the
+    # example's 1 (rank-0 leaves keep their empty shape)
+    assert manifest["input_signature"]["v"]["shape"] == [None, 4]
+    assert manifest["input_signature"]["temp"]["shape"] == []
     model = load_servable(str(tmp_path / "e"))
     for batch in (1, 3, 7):  # != the example's batch of 1
         out = np.asarray(model.predict(
@@ -163,6 +169,23 @@ def test_polymorphic_batch_export(tmp_path):
         ))
         assert out.shape == (batch, 2)
         np.testing.assert_allclose(out[0], [24.0, 32.0])
+
+    # Inputs that DISAGREE on their leading dim must not get a shared
+    # batch symbol (the export would succeed but reject its own example
+    # shapes at serving time): fixed-shape export instead.
+    manifest2 = export_servable(
+        str(tmp_path / "e2"),
+        lambda p, x: x["a"].sum() + x["b"].sum() + p["w"],
+        {"w": np.float32(0.0)},
+        {"a": np.zeros((2, 3), np.float32),
+         "b": np.zeros((5,), np.float32)},
+        platforms=("cpu",),
+    )
+    assert manifest2["polymorphic_batch"] is False
+    model2 = load_servable(str(tmp_path / "e2"))
+    out2 = model2.predict({"a": np.ones((2, 3), np.float32),
+                           "b": np.ones((5,), np.float32)})
+    np.testing.assert_allclose(np.asarray(out2), 11.0)
 
 
 def test_model_server_rest_surface(tmp_path):
