@@ -1,0 +1,132 @@
+"""Data-plane throughput: the IO side of the training path.
+
+bench.py trains on device-resident synthetic batches and promises "the
+data plane is benchmarked separately" — this is that benchmark.  It
+measures records/sec and MB/sec through the real reader stack
+(data/recio.py, data/reader.py, data/parallel_reader.py) on records
+sized like the headline workloads:
+
+  recio_seq       sequential recio shard read (raw payload path)
+  recio_shuffled  random-access read honoring a permutation
+                  (the master's shuffle contract, O(1) seeks)
+  csv             TextDataReader line reads via its byte-offset index
+  recio_parallel  ParallelShardReader over a 4-process spawn pool
+                  (NOTE: this image pins everything to one core, so the
+                  pool measures dispatch overhead, not speedup — on
+                  multi-core hosts the same path scales by process)
+
+Reference anchor: the data layer the reference benchmarks through its
+RecordIO reader + ODPS multiprocess reader
+(elasticdl/python/data/reader/recordio_reader.py:27-63, odps_io).
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+RECORD_BYTES = 1024       # ~CIFAR/CTR example scale
+NUM_RECORDS = 50_000
+
+
+def _build_dataset(root):
+    from elasticdl_tpu.data.recio import RecioWriter
+
+    payload = os.urandom(RECORD_BYTES)
+    recio_path = os.path.join(root, "shard-0.rec")
+    with RecioWriter(recio_path) as w:
+        for _ in range(NUM_RECORDS):
+            w.write(payload)
+    csv_path = os.path.join(root, "data.csv")
+    line = ",".join(["0.123456"] * 16) + ",1\n"
+    with open(csv_path, "w") as f:
+        f.write(line * NUM_RECORDS)
+    return recio_path, csv_path
+
+
+def _rate(fn, n_records, bytes_per_record):
+    t0 = time.perf_counter()
+    count = fn()
+    secs = time.perf_counter() - t0
+    assert count == n_records, (count, n_records)
+    return {
+        "records_per_sec": round(count / secs, 1),
+        "mb_per_sec": round(count * bytes_per_record / secs / 2**20, 1),
+        "secs": round(secs, 3),
+    }
+
+
+def run_bench():
+    import numpy as np
+
+    from elasticdl_tpu.data.parallel_reader import (
+        ParallelShardReader,
+        _make_task,
+    )
+    from elasticdl_tpu.data.reader import RecioDataReader, TextDataReader
+
+    rows = {}
+    with tempfile.TemporaryDirectory(prefix="edl_bench_data_") as root:
+        recio_path, csv_path = _build_dataset(root)
+
+        reader = RecioDataReader(root)
+        task = _make_task(recio_path, 0, NUM_RECORDS)
+        reader._reader(recio_path)  # build the offset index untimed
+        rows["recio_seq"] = _rate(
+            lambda: sum(1 for _ in reader.read_records(task)),
+            NUM_RECORDS, RECORD_BYTES,
+        )
+
+        perm = np.random.RandomState(0).permutation(NUM_RECORDS)
+        shuffled = _make_task(
+            recio_path, 0, NUM_RECORDS, record_indices=perm.tolist()
+        )
+        rows["recio_shuffled"] = _rate(
+            lambda: sum(1 for _ in reader.read_records(shuffled)),
+            NUM_RECORDS, RECORD_BYTES,
+        )
+
+        csv_reader = TextDataReader(csv_path, records_per_task=NUM_RECORDS)
+        csv_task = _make_task(csv_path, 0, NUM_RECORDS)
+        csv_bytes = os.path.getsize(csv_path) / NUM_RECORDS
+        rows["csv"] = _rate(
+            lambda: sum(1 for _ in csv_reader.read_records(csv_task)),
+            NUM_RECORDS, csv_bytes,
+        )
+
+        import functools
+
+        with ParallelShardReader(
+            functools.partial(RecioDataReader, root),
+            num_processes=4, records_per_subrange=2048,
+        ) as preader:
+            # warm the pool: spawn startup + per-process index scans
+            # must not pollute the steady-state measurement
+            sum(1 for _ in preader.read_records(task))
+            rows["recio_parallel"] = _rate(
+                lambda: sum(1 for _ in preader.read_records(task)),
+                NUM_RECORDS, RECORD_BYTES,
+            )
+
+    return {
+        "metric": "data_plane_read_throughput",
+        "value": rows["recio_seq"]["records_per_sec"],
+        "unit": "records/sec (recio sequential)",
+        "vs_baseline": None,
+        "detail": {
+            "record_bytes": RECORD_BYTES,
+            "num_records": NUM_RECORDS,
+            "nproc": os.cpu_count(),
+            **rows,
+            "baseline": "reference publishes no reader throughput; "
+                        "this is the framework's own anchor",
+        },
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_bench()))
+    sys.exit(0)

@@ -252,6 +252,52 @@ def test_model_server_rest_surface(tmp_path):
         server.server_close()
 
 
+def test_model_server_concurrent_predicts(tmp_path):
+    """N threads hammer :predict concurrently; the endpoint lock keeps
+    results correct and every request gets a response."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.server import ModelEndpoint, build_server
+
+    export_servable(
+        str(tmp_path / "e"),
+        lambda p, x: x * p["s"],
+        {"s": np.float32(3.0)},
+        np.zeros((1, 2), np.float32),
+        model_name="c",
+        platforms=("cpu",),
+    )
+    server = build_server(ModelEndpoint(str(tmp_path / "e")), port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d/v1/models/c:predict" % port
+    results = {}
+
+    def hit(k):
+        req = urllib.request.Request(
+            url, data=_json.dumps(
+                {"instances": [[k, k + 1]]}).encode())
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            results[k] = _json.loads(resp.read())["predictions"]
+
+    try:
+        threads = [threading.Thread(target=hit, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == 8
+        for k, out in results.items():
+            np.testing.assert_allclose(out, [[3.0 * k, 3.0 * (k + 1)]])
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_embedding_lookup_large_table_is_o_batch(tmp_path):
     """100k-row table: lookups must use the index built once in
     __init__, not rebuild an O(table) dict per call (VERDICT r3 #7)."""
