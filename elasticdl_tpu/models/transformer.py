@@ -400,7 +400,8 @@ def forward(params, tokens, cfg, mesh=None, return_aux=False):
 
 
 def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
-                      remat=False, return_aux=False):
+                      remat=False, return_aux=False,
+                      return_hidden=False):
     """Microbatch-pipelined forward over the ``pp`` mesh axis.
 
     The layer stack runs as a GPipe schedule (parallel/pipeline.py):
@@ -471,14 +472,17 @@ def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
             num_microbatches=num_microbatches, remat=remat,
         )
     x = merge_microbatches(ym)
-    logits = _head(params, x, cfg)
+    # The head runs on the MERGED hidden states outside the pipeline,
+    # so ``return_hidden`` composes with the chunked loss exactly like
+    # the scanned forward's forward_hidden.
+    out = x if return_hidden else _head(params, x, cfg)
     if return_aux:
         if not collect_aux:  # dense model asked for aux: trivially zero
-            return logits, jnp.float32(0.0)
+            return out, jnp.float32(0.0)
         # aux_sum covers ALL layers (stages sum via psum); normalize to
         # mean-per-layer to match forward(return_aux=True).
-        return logits, aux_sum / cfg.num_layers
-    return logits
+        return out, aux_sum / cfg.num_layers
+    return out
 
 
 def next_token_loss(logits, tokens):
@@ -537,12 +541,23 @@ def next_token_loss_chunked(params, hidden, tokens, cfg, chunk=512):
 def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
                seq_len=512, learning_rate=3e-4, mesh=None, dtype="bfloat16",
                pipeline_microbatches=0, moe_experts=0, moe_top_k=2,
-               moe_aux_weight=0.01):
+               moe_aux_weight=0.01, remat=False, attention_impl="ring",
+               window=0, xent_chunk=0):
+    """Zoo entry for the flagship LM.
+
+    ``remat`` (False | True | "dots" | "attn"), ``attention_impl``
+    ("ring" | "ulysses"), and ``window`` (sliding-window causal, 0 =
+    full) pass through to :class:`TransformerConfig`.  ``xent_chunk``
+    > 0 computes the loss via :func:`next_token_loss_chunked` — no
+    [B, T, V] logits tensor, the memory-lean path for large
+    vocab x seq (numerically identical, tested).
+    """
     cfg = TransformerConfig(
         vocab_size=vocab_size, dim=dim, num_heads=num_heads,
         num_layers=num_layers, max_seq_len=seq_len, dtype=dtype,
         moe_experts=moe_experts, moe_top_k=moe_top_k,
-        moe_aux_weight=moe_aux_weight,
+        moe_aux_weight=moe_aux_weight, remat=remat,
+        attention_impl=attention_impl, window=window,
     )
     pipelined = (
         pipeline_microbatches > 0
@@ -550,6 +565,22 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
         and mesh.shape.get("pp", 1) > 1
         and mesh.shape.get("sp", 1) == 1
     )
+    if not (
+        remat in (False, True, "dots", "attn")
+    ):
+        # CLI model_params arrive as strings; normalize the booleans and
+        # reject typos instead of silently enabling full remat (any
+        # truthy non-keyword string would take the jax.checkpoint
+        # branch).
+        normalized = {"false": False, "true": True,
+                      "dots": "dots", "attn": "attn"}.get(
+            str(remat).strip().lower())
+        if normalized is None:
+            raise ValueError(
+                "remat must be one of False, True, 'dots', 'attn'; "
+                "got %r" % (remat,))
+        remat = normalized
+        cfg = dataclasses.replace(cfg, remat=remat)
     if pipeline_microbatches > 0 and not pipelined:
         # No mesh, pp=1, or sp>1 (ring attention needs the sequence
         # axis): say so instead of silently ignoring the knob.
@@ -569,17 +600,42 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
 
     def apply_fn(params, tokens, train):
         if pipelined:
+            if xent_chunk and train:
+                hidden, aux = forward_pipelined(
+                    params, tokens, cfg, mesh, pipeline_microbatches,
+                    remat=bool(cfg.remat), return_aux=True,
+                    return_hidden=True,
+                )
+                return ("hidden", hidden, aux, params)
             return forward_pipelined(
                 params, tokens, cfg, mesh, pipeline_microbatches,
                 remat=bool(cfg.remat),
                 return_aux=bool(cfg.moe_experts and train),
             )
+        if xent_chunk and train:
+            # Memory-lean loss path: hand the final hidden states (and
+            # the params, for the head matmul inside the chunked loss)
+            # to loss_fn instead of materializing [B, T, V] logits.
+            hidden, aux = forward_hidden(params, tokens, cfg, mesh=mesh)
+            return ("hidden", hidden, aux, params)
         if cfg.moe_experts and train:
             return forward(params, tokens, cfg, mesh=mesh,
                            return_aux=True)
         return forward(params, tokens, cfg, mesh=mesh)
 
     def loss_fn(outputs, tokens):
+        if (
+            isinstance(outputs, tuple)
+            and len(outputs) == 4
+            and outputs[0] == "hidden"
+        ):
+            _, hidden, aux, params = outputs
+            loss = next_token_loss_chunked(
+                params, hidden, tokens, cfg, chunk=xent_chunk
+            )
+            if cfg.moe_experts:
+                loss = loss + cfg.moe_aux_weight * aux
+            return loss
         if isinstance(outputs, tuple):  # MoE training: (logits, aux)
             logits, aux = outputs
             return (

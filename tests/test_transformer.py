@@ -80,6 +80,58 @@ def test_chunked_xent_matches_dense(params, chunk):
     )
 
 
+def test_model_spec_xent_chunk_trains_like_dense():
+    """model_spec(xent_chunk=N) is a product option: same loss as the
+    dense spec through a real CollectiveTrainer minibatch."""
+    from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+    kwargs = dict(vocab_size=64, dim=32, num_heads=2, num_layers=2,
+                  seq_len=16, dtype="float32")
+    toks = np.random.RandomState(1).randint(
+        0, 64, size=(4, 16)).astype(np.int32)
+    losses = {}
+    for name, extra in (("dense", {}), ("chunked", {"xent_chunk": 8})):
+        spec = tfm.model_spec(**kwargs, **extra)
+        trainer = CollectiveTrainer(spec, batch_size=4)
+        loss, _ = trainer.train_minibatch(toks, toks)
+        losses[name] = float(loss)
+    assert np.isfinite(losses["chunked"])
+    np.testing.assert_allclose(losses["chunked"], losses["dense"],
+                               rtol=1e-5)
+
+
+def test_model_spec_remat_validation():
+    """CLI model_params arrive as strings: booleans normalize, typos
+    raise instead of silently enabling full remat."""
+    spec = tfm.model_spec(vocab_size=64, dim=32, num_heads=2,
+                          num_layers=2, seq_len=16, remat="False")
+    assert spec.config.remat is False
+    spec = tfm.model_spec(vocab_size=64, dim=32, num_heads=2,
+                          num_layers=2, seq_len=16, remat="attn")
+    assert spec.config.remat == "attn"
+    with pytest.raises(ValueError, match="remat"):
+        tfm.model_spec(vocab_size=64, dim=32, num_heads=2,
+                       num_layers=2, seq_len=16, remat="atn")
+
+
+def test_model_spec_xent_chunk_pipelined_matches_dense():
+    """xent_chunk works ON the pipelined path (the head runs on merged
+    hidden states outside the pipeline) — same loss as dense pipelined."""
+    mesh = build_mesh(pp=2, devices=jax.devices()[:2])
+    kwargs = dict(vocab_size=64, dim=32, num_heads=2, num_layers=2,
+                  seq_len=16, dtype="float32", mesh=mesh,
+                  pipeline_microbatches=2)
+    toks = make_tokens(b=4, t=16, seed=5)
+    spec_d = tfm.model_spec(**kwargs)
+    spec_c = tfm.model_spec(**kwargs, xent_chunk=8)
+    params_d = spec_d.init_fn(jax.random.PRNGKey(0))
+    loss_d = spec_d.loss_fn(spec_d.apply_fn(params_d, toks, True), toks)
+    params_c = spec_c.init_fn(jax.random.PRNGKey(0))
+    loss_c = spec_c.loss_fn(spec_c.apply_fn(params_c, toks, True), toks)
+    np.testing.assert_allclose(np.asarray(loss_d), np.asarray(loss_c),
+                               rtol=1e-5)
+
+
 @pytest.mark.parametrize("remat", [True, "attn", "dots"])
 def test_remat_policies_preserve_gradients(params, remat):
     tokens = make_tokens(b=2, t=16)
