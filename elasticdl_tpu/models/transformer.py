@@ -68,10 +68,26 @@ class TransformerConfig:
     # blocks skip matmuls and DMA in the flash kernel, and whole ring
     # steps skip when the shard lies past the band).
     window: int = 0
+    # Grouped-query attention: 0 = MHA (kv heads == num_heads); G > 0
+    # projects K/V to G heads and each group of num_heads/G query heads
+    # shares one — smaller wk/wv params + projection FLOPs, and the
+    # G-head KV cache is the standard serving memory win.  Q heads are
+    # grouped consecutively (head i attends kv head i // (H/G)).
+    num_kv_heads: int = 0
 
     @property
     def head_dim(self):
         return self.dim // self.num_heads
+
+    @property
+    def kv_heads(self):
+        """Effective K/V head count (num_kv_heads=0 -> MHA)."""
+        kv = self.num_kv_heads or self.num_heads
+        if kv <= 0 or self.num_heads % kv:
+            raise ValueError(
+                "num_heads (%d) must be a positive multiple of "
+                "num_kv_heads (%d)" % (self.num_heads, kv))
+        return kv
 
     @property
     def mlp_dim(self):
@@ -96,11 +112,12 @@ def init_params(rng, cfg):
         return jax.random.normal(key, shape, jnp.float32) * scale
 
     keys = jax.random.split(k_attn, 6)
+    G = cfg.kv_heads
     layers = {
         "ln1": norm_init(L, E),
         "wq": dense_init(keys[0], L, E, H * D),
-        "wk": dense_init(keys[1], L, E, H * D),
-        "wv": dense_init(keys[2], L, E, H * D),
+        "wk": dense_init(keys[1], L, E, G * D),
+        "wv": dense_init(keys[2], L, E, G * D),
         "wo": dense_init(keys[3], L, H * D, E),
         "ln2": norm_init(L, E),
     }
@@ -288,12 +305,21 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
     act_spec = P("dp", "sp", None)
     B, T = x.shape[0], x.shape[1]
     H, D = cfg.num_heads, cfg.head_dim
+    G = cfg.kv_heads
     h = _rmsnorm(x, w["ln1"].astype(compute_dtype))
     q = (h @ w["wq"].astype(compute_dtype)).reshape(B, T, H, D)
-    k = (h @ w["wk"].astype(compute_dtype)).reshape(B, T, H, D)
-    v = (h @ w["wv"].astype(compute_dtype)).reshape(B, T, H, D)
+    k = (h @ w["wk"].astype(compute_dtype)).reshape(B, T, G, D)
+    v = (h @ w["wv"].astype(compute_dtype)).reshape(B, T, G, D)
     q = _rope(q, positions)
     k = _rope(k, positions)
+    if G != H:
+        # GQA: expand K/V to the full head count for the (unchanged)
+        # attention kernels.  jnp.repeat keeps group order consecutive,
+        # matching the q-head grouping convention (head i -> kv head
+        # i // (H/G)); XLA lowers this to a broadcast feeding the
+        # score matmuls.
+        k = jnp.repeat(k, H // G, axis=2)
+        v = jnp.repeat(v, H // G, axis=2)
     if mesh is None and attention_mode is not None:
         from elasticdl_tpu.parallel.ring_attention import attention_local
 
@@ -542,15 +568,17 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
                seq_len=512, learning_rate=3e-4, mesh=None, dtype="bfloat16",
                pipeline_microbatches=0, moe_experts=0, moe_top_k=2,
                moe_aux_weight=0.01, remat=False, attention_impl="ring",
-               window=0, xent_chunk=0):
+               window=0, xent_chunk=0, num_kv_heads=0):
     """Zoo entry for the flagship LM.
 
     ``remat`` (False | True | "dots" | "attn"), ``attention_impl``
-    ("ring" | "ulysses"), and ``window`` (sliding-window causal, 0 =
-    full) pass through to :class:`TransformerConfig`.  ``xent_chunk``
-    > 0 computes the loss via :func:`next_token_loss_chunked` — no
-    [B, T, V] logits tensor, the memory-lean path for large
-    vocab x seq (numerically identical, tested).
+    ("ring" | "ulysses"), ``window`` (sliding-window causal, 0 = full),
+    and ``num_kv_heads`` (grouped-query attention: 0 = MHA, G > 0
+    shares each K/V head across num_heads/G query heads) pass through
+    to :class:`TransformerConfig`.  ``xent_chunk`` > 0 computes the
+    loss via :func:`next_token_loss_chunked` — no [B, T, V] logits
+    tensor, the memory-lean path for large vocab x seq (numerically
+    identical, tested).
     """
     cfg = TransformerConfig(
         vocab_size=vocab_size, dim=dim, num_heads=num_heads,
@@ -558,7 +586,9 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
         moe_experts=moe_experts, moe_top_k=moe_top_k,
         moe_aux_weight=moe_aux_weight, remat=remat,
         attention_impl=attention_impl, window=window,
+        num_kv_heads=num_kv_heads,
     )
+    cfg.kv_heads  # validate num_heads % num_kv_heads at spec build
     pipelined = (
         pipeline_microbatches > 0
         and mesh is not None

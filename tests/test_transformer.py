@@ -100,6 +100,58 @@ def test_model_spec_xent_chunk_trains_like_dense():
                                rtol=1e-5)
 
 
+def test_gqa_equals_mha_with_tiled_kv_weights():
+    """GQA correctness by construction: a GQA forward must EXACTLY
+    equal the MHA forward whose wk/wv are the GQA weights tile-repeated
+    per group (k_mha = repeat(k_gqa) by definition)."""
+    cfg_g = dataclasses.replace(CFG, num_kv_heads=2)  # H=4, G=2
+    params_g = tfm.init_params(jax.random.PRNGKey(3), cfg_g)
+    L, E = CFG.num_layers, CFG.dim
+    H, D, G = CFG.num_heads, CFG.head_dim, 2
+    params_m = jax.tree_util.tree_map(lambda x: x, params_g)  # copy refs
+    for name in ("wk", "wv"):
+        w = np.asarray(params_g["layers"][name]).reshape(L, E, G, D)
+        params_m["layers"][name] = jnp.asarray(
+            np.repeat(w, H // G, axis=2).reshape(L, E, H * D)
+        )
+    tokens = make_tokens(b=2, t=32, seed=4)
+    out_g = tfm.forward(params_g, tokens, cfg_g)
+    out_m = tfm.forward(params_m, tokens, CFG)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_m),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gqa_sharded_matches_single_device():
+    """GQA under dp/tp/sp sharding matches the single-device forward."""
+    cfg_g = dataclasses.replace(CFG, num_kv_heads=2)
+    params_g = tfm.init_params(jax.random.PRNGKey(3), cfg_g)
+    tokens = make_tokens()
+    ref = np.asarray(tfm.forward(params_g, tokens, cfg_g))
+    mesh = build_mesh(dp=2, tp=2, sp=2)
+    sharded = tfm.shard_params(params_g, mesh, cfg_g)
+    out = jax.jit(
+        lambda p, t: tfm.forward(p, t, cfg_g, mesh=mesh)
+    )(sharded, tokens)
+    np.testing.assert_allclose(ref, np.asarray(out), rtol=5e-4,
+                               atol=5e-4)
+
+
+def test_gqa_trains_and_validates():
+    from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+    spec = tfm.model_spec(vocab_size=64, dim=32, num_heads=4,
+                          num_layers=2, seq_len=16, dtype="float32",
+                          num_kv_heads=2)
+    assert spec.config.kv_heads == 2
+    toks = make_tokens(b=4, t=16, seed=6)
+    trainer = CollectiveTrainer(spec, batch_size=4)
+    loss, _ = trainer.train_minibatch(toks % 64, toks % 64)
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        tfm.model_spec(vocab_size=64, dim=32, num_heads=4,
+                       num_layers=2, seq_len=16, num_kv_heads=3)
+
+
 def test_model_spec_remat_validation():
     """CLI model_params arrive as strings: booleans normalize, typos
     raise instead of silently enabling full remat."""
