@@ -296,11 +296,13 @@ def _constrain(x, mesh, spec):
 
 
 def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
-                moe_stats=False):
+                moe_stats=False, return_kv=False):
     """One transformer block; shared by the scanned stack (forward) and
     the per-stage slice scan (forward_pipelined).  ``moe_stats`` swaps
     the scalar aux for the linear [2, X] router statistics (pipeline
-    accumulation)."""
+    accumulation).  ``return_kv`` additionally returns this layer's
+    post-RoPE, pre-GQA-expand (k, v) [B, T, G, D] — the decode prefill
+    captures them into the KV cache."""
     compute_dtype = jnp.dtype(cfg.dtype)
     act_spec = P("dp", "sp", None)
     B, T = x.shape[0], x.shape[1]
@@ -312,6 +314,7 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
     v = (h @ w["wv"].astype(compute_dtype)).reshape(B, T, G, D)
     q = _rope(q, positions)
     k = _rope(k, positions)
+    kv_out = (k, v) if return_kv else None
     if G != H:
         # GQA: expand K/V to the full head count for the (unchanged)
         # attention kernels.  jnp.repeat keeps group order consecutive,
@@ -354,7 +357,7 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
         moe_out, aux, stats = _moe_ffn(h, w, cfg, mesh)
         x = x + _constrain(moe_out, mesh, act_spec)
         if moe_stats:
-            return x, stats
+            return (x, (stats, kv_out)) if return_kv else (x, stats)
     else:
         gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
         up = h @ w["w_up"].astype(compute_dtype)
@@ -363,6 +366,8 @@ def _layer_body(x, w, cfg, mesh, positions, attention_mode=None,
             act_spec,
         )
         aux = jnp.float32(0.0)
+    if return_kv:
+        return x, (aux, kv_out)
     return x, aux
 
 
@@ -509,6 +514,169 @@ def forward_pipelined(params, tokens, cfg, mesh, num_microbatches,
         # mean-per-layer to match forward(return_aux=True).
         return out, aux_sum / cfg.num_layers
     return out
+
+
+# -- autoregressive decoding ---------------------------------------------------
+
+NEG_INF_DECODE = -1e30
+
+
+def init_kv_cache(cfg, batch, max_len):
+    """Zeroed per-layer K/V caches, each [L, B, max_len, G, D].
+
+    G = cfg.kv_heads: with grouped-query attention the cache holds G
+    heads, not num_heads — the standard serving memory win (e.g. G=2,
+    H=16 caches 8x less KV).
+    """
+    shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _decode_layer(x, w, cfg, ck, cv, pos):
+    """One block for ONE position.  x: [B, 1, E]; ck/cv: [B, max, G, D]
+    caches (updated at ``pos`` and returned).  Attention is the single
+    query against the cache, computed grouped (no K/V head repeat)."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+    B = x.shape[0]
+    H, D, G = cfg.num_heads, cfg.head_dim, cfg.kv_heads
+    R = H // G
+    positions = jnp.reshape(pos, (1,))
+    h = _rmsnorm(x, w["ln1"].astype(compute_dtype))
+    q = _rope((h @ w["wq"].astype(compute_dtype)).reshape(B, 1, H, D),
+              positions)
+    k = _rope((h @ w["wk"].astype(compute_dtype)).reshape(B, 1, G, D),
+              positions)
+    v = (h @ w["wv"].astype(compute_dtype)).reshape(B, 1, G, D)
+    ck = jax.lax.dynamic_update_slice(
+        ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cv, v.astype(cv.dtype), (0, pos, 0, 0))
+
+    qg = q.reshape(B, G, R, D).astype(jnp.float32)
+    s = jnp.einsum(
+        "bgrd,btgd->bgrt", qg, ck.astype(jnp.float32),
+    ) * (D ** -0.5)                                   # [B, G, R, max]
+    idx = jnp.arange(ck.shape[1])
+    valid = idx <= pos
+    if cfg.window:
+        valid &= (pos - idx) < cfg.window
+    s = jnp.where(valid[None, None, None, :], s, NEG_INF_DECODE)
+    p = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum(
+        "bgrt,btgd->bgrd", p, cv.astype(jnp.float32)
+    ).reshape(B, 1, H * D).astype(compute_dtype)
+    x = x + attn @ w["wo"].astype(compute_dtype)
+
+    h = _rmsnorm(x, w["ln2"].astype(compute_dtype))
+    if cfg.moe_experts:
+        moe_out, _aux, _stats = _moe_ffn(h, w, cfg, None)
+        x = x + moe_out
+    else:
+        gate = jax.nn.silu(h @ w["w_gate"].astype(compute_dtype))
+        up = h @ w["w_up"].astype(compute_dtype)
+        x = x + (gate * up) @ w["w_down"].astype(compute_dtype)
+    return x, ck, cv
+
+
+def prefill(params, cfg, prompt, max_len):
+    """Batched prefill: ONE forward pass over the prompt computes every
+    layer's K/V and writes them into fresh caches of length
+    ``max_len``.  Returns (last-position logits [B, V], caches).  This
+    is the time-to-first-token path — Tp sequential decode steps would
+    be MXU-starved serialized work."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+    b, tp = prompt.shape
+    x = params["embed"].astype(compute_dtype)[prompt]
+    positions = jnp.arange(tp)
+
+    def layer(x, w):
+        x, (_aux, kv) = _layer_body(
+            x, w, cfg, None, positions, return_kv=True
+        )
+        return x, kv
+
+    x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+    ck, cv = init_kv_cache(cfg, b, max_len)  # [L, B, max, G, D]
+    ck = jax.lax.dynamic_update_slice(
+        ck, ks.astype(ck.dtype), (0, 0, 0, 0, 0))
+    cv = jax.lax.dynamic_update_slice(
+        cv, vs.astype(cv.dtype), (0, 0, 0, 0, 0))
+    logits = _head(params, x, cfg)[:, -1]
+    return logits, (ck, cv)
+
+
+def decode_step(params, cfg, caches, pos, tokens_1):
+    """One decode step: tokens_1 [B] int32 at position ``pos`` ->
+    (logits [B, V], updated caches).  ``caches`` from
+    :func:`init_kv_cache`."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"].astype(compute_dtype)[tokens_1][:, None, :]
+
+    def body(x, inputs):
+        w, ck, cv = inputs
+        x, ck, cv = _decode_layer(x, w, cfg, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, new_caches = jax.lax.scan(body, x, (params["layers"],) + caches)
+    logits = _head(params, x, cfg)[:, 0]
+    return logits, new_caches
+
+
+def generate(params, cfg, prompt, max_new_tokens, temperature=0.0,
+             rng=None):
+    """Autoregressive generation: batched prefill + KV-cache decode.
+
+    prompt: [B, Tp] int32, Tp >= 1 (seed unconditional generation with
+    a BOS token).  Returns [B, Tp + max_new_tokens]; greedy when
+    ``temperature`` == 0, else softmax sampling at the given
+    temperature.  Positions use RoPE, so sequences may run past
+    cfg.max_seq_len (quality, not correctness, degrades).
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, tp = prompt.shape
+    if tp == 0:
+        raise ValueError(
+            "prompt must have at least one token (use a BOS token for "
+            "unconditional generation)")
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens == 0:
+        return prompt
+    total = tp + max_new_tokens
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    greedy = not temperature
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    logits0, caches = prefill(params, cfg, prompt, total)
+    rng, sub = jax.random.split(rng)
+    first = sample(logits0, sub)
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1
+    )
+    tokens = jax.lax.dynamic_update_index_in_dim(
+        tokens, first, tp, axis=1)
+
+    def body(carry, t):
+        tokens, caches, rng = carry
+        tok_t = jax.lax.dynamic_index_in_dim(
+            tokens, t, axis=1, keepdims=False)
+        logits, caches = decode_step(params, cfg, caches, t, tok_t)
+        rng, sub = jax.random.split(rng)
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, sample(logits, sub), t + 1, axis=1)
+        return (tokens, caches, rng), None
+
+    (tokens, _, _), _ = jax.lax.scan(
+        body, (tokens, caches, rng), jnp.arange(tp, total - 1)
+    )
+    return tokens
 
 
 def next_token_loss(logits, tokens):
