@@ -19,6 +19,11 @@ Flagship LM (bench_transformer.py, 436M params, tok/s):
   attn+chunked      remat="attn" + chunked loss
   attn+chunked_b16  same at batch 16 (memory freed by the above)
 
+Decode (bench_transformer.py --decode, generated tok/s):
+  decode_mha        KV-cache decode, full head count
+  decode_gqa4       grouped-query attention, 4 KV heads (4x smaller
+                    cache on the HBM-bound decode path)
+
 Use: run with a healthy relay; results go to BENCHMARKS.md and winners
 become defaults.  A wedged relay costs one failed probe (<=90 s), not
 the whole matrix.
@@ -38,6 +43,11 @@ RESNET_CONFIGS = [
     ("s2d", {"ELASTICDL_FUSED_GN": "off", "ELASTICDL_RESNET_S2D": "1"}),
     ("s2d+fusedgn",
      {"ELASTICDL_FUSED_GN": "tpu", "ELASTICDL_RESNET_S2D": "1"}),
+]
+
+DECODE_CONFIGS = [
+    ("decode_mha", {}),
+    ("decode_gqa4", {"ELASTICDL_BENCH_KV_HEADS": "4"}),
 ]
 
 LM_CONFIGS = [
@@ -115,6 +125,22 @@ def main():
         )
         print("lm/%s: %s (%.0fs)" % (
             name, rows["lm"][name], time.monotonic() - t0),
+            file=sys.stderr, flush=True)
+
+    rows["decode"] = {}
+    for name, env in DECODE_CONFIGS:
+        t0 = time.monotonic()
+        res, reason, _rc = _run(
+            ["bench_transformer.py", "--decode"], env, per_cfg)
+        rows["decode"][name] = (
+            {"tok_per_sec": res["value"],
+             "ms_per_token_batch": res["detail"]["ms_per_token_batch"],
+             "kv_heads": res["detail"]["kv_heads"],
+             "compile_secs": res["detail"]["compile_secs"]}
+            if res else {"error": reason}
+        )
+        print("decode/%s: %s (%.0fs)" % (
+            name, rows["decode"][name], time.monotonic() - t0),
             file=sys.stderr, flush=True)
 
     print(json.dumps({"metric": "kernel_ab_matrix", "rows": rows}))

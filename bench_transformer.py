@@ -139,6 +139,80 @@ def run_bench(warmup=2, iters=10):
     }
 
 
+def run_decode_bench(batch=8, prompt_len=128, new_tokens=128):
+    """Serving-side decode throughput: batched prefill + KV-cache
+    decode as ONE jitted program (generated tokens/sec/chip).
+
+    ELASTICDL_BENCH_KV_HEADS picks the GQA group count (0 = MHA) — the
+    A/B axis where the smaller KV cache pays on HBM-bound decode.
+    """
+    import jax
+
+    if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+        jax.config.update(
+            "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError:
+        pass
+    import numpy as np
+
+    from elasticdl_tpu.models import transformer as tfm
+
+    platform = jax.devices()[0].platform
+    dim, layers, heads = DIM, LAYERS, HEADS
+    if platform == "cpu":
+        dim, layers, heads = 256, 4, 8
+        batch, prompt_len, new_tokens = 2, 16, 16
+
+    kv_heads = int(os.environ.get("ELASTICDL_BENCH_KV_HEADS", "0"))
+    cfg = tfm.TransformerConfig(
+        vocab_size=VOCAB, dim=dim, num_heads=heads, num_layers=layers,
+        max_seq_len=prompt_len + new_tokens, dtype="bfloat16",
+        num_kv_heads=kv_heads,
+    )
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.device_put(np.random.RandomState(0).randint(
+        0, VOCAB, size=(batch, prompt_len)).astype(np.int32))
+
+    gen = jax.jit(
+        lambda p, t: tfm.generate(p, cfg, t, max_new_tokens=new_tokens)
+    )
+    compile_start = time.perf_counter()
+    out = gen(params, prompt)
+    int(out[0, -1])  # fence (relay does not fence block_until_ready)
+    compile_secs = time.perf_counter() - compile_start
+    iters = 3
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = gen(params, prompt)
+    int(out[0, -1])
+    elapsed = time.perf_counter() - start
+
+    tok_per_sec = batch * new_tokens * iters / elapsed
+    return {
+        "metric": "transformer_lm_decode_throughput",
+        "value": round(tok_per_sec, 1),
+        "unit": "generated tokens/sec/chip",
+        "vs_baseline": None,
+        "detail": {
+            "platform": platform,
+            "batch": batch, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "kv_heads": kv_heads or heads,
+            "num_heads": heads, "dim": dim, "layers": layers,
+            "ms_per_token_batch": round(
+                1000.0 * elapsed / (new_tokens * iters), 3),
+            "compile_secs": round(compile_secs, 1),
+        },
+    }
+
+
 if __name__ == "__main__":
-    print(json.dumps(run_bench()))
+    if "--decode" in sys.argv:
+        print(json.dumps(run_decode_bench()))
+    else:
+        print(json.dumps(run_bench()))
     sys.exit(0)
