@@ -639,6 +639,10 @@ def generate(params, cfg, prompt, max_new_tokens, temperature=0.0,
         raise ValueError(
             "prompt must have at least one token (use a BOS token for "
             "unconditional generation)")
+    # Accept numpy-loaded params (e.g. a servable export's npz):
+    # indexing a numpy embed table with a traced token id would fail
+    # inside the decode scan.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
     max_new_tokens = int(max_new_tokens)
     if max_new_tokens == 0:
         return prompt
