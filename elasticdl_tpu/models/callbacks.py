@@ -20,10 +20,17 @@ logger = get_logger(__name__)
 
 
 class ModelExporter:
-    def __init__(self, export_dir, checkpoint_dir=None, model_name=""):
+    def __init__(self, export_dir, checkpoint_dir=None, model_name="",
+                 versioned=False):
+        """With ``versioned`` the export lands in
+        ``export_dir/<trainer.version>/`` (the TF-Serving layout), so a
+        live ``serving.server`` pointed at ``export_dir`` hot-swaps to
+        it; otherwise ``export_dir`` itself is the export (flat, the
+        historical layout)."""
         self.export_dir = export_dir
         self.checkpoint_dir = checkpoint_dir
         self.model_name = model_name
+        self.versioned = versioned
 
     def _merged_embeddings(self):
         """({table: (ids, values)}, dense, version) from the latest PS
@@ -46,6 +53,10 @@ class ModelExporter:
         return embeddings, ckpt_dense, version
 
     def on_train_end(self, trainer):
+        export_dir = self.export_dir
+        if self.versioned:
+            export_dir = os.path.join(
+                export_dir, str(getattr(trainer, "version", 0)))
         embeddings, ckpt_dense, ckpt_version = self._merged_embeddings()
         if (
             ckpt_dense
@@ -69,7 +80,7 @@ class ModelExporter:
 
             infer_fn, params, example = bundle
             export_servable(
-                self.export_dir, infer_fn, params, example,
+                export_dir, infer_fn, params, example,
                 model_name=self.model_name,
                 version=getattr(trainer, "version", 0),
                 embeddings=embeddings,
@@ -77,14 +88,14 @@ class ModelExporter:
             )
             return
         # Fallback (no bundle): weights-only v1 export.
-        os.makedirs(self.export_dir, exist_ok=True)
+        os.makedirs(export_dir, exist_ok=True)
         payload = dict(trainer.export_parameters())
         payload.update(ckpt_dense)
         flat_emb = {}
         for name, (ids, values) in embeddings.items():
             flat_emb["emb_ids/" + name] = ids
             flat_emb["emb_vals/" + name] = values
-        path = os.path.join(self.export_dir, "model.npz")
+        path = os.path.join(export_dir, "model.npz")
         with open(path, "wb") as f:
             np.savez(f, **payload, **flat_emb)
         manifest = {
@@ -94,11 +105,11 @@ class ModelExporter:
             "embedding_tables": sorted(embeddings),
             "version": getattr(trainer, "version", 0),
         }
-        with open(os.path.join(self.export_dir, "manifest.json"),
+        with open(os.path.join(export_dir, "manifest.json"),
                   "w") as f:
             json.dump(manifest, f, indent=2)
         logger.info("exported model to %s (%d tensors)",
-                    self.export_dir, len(payload))
+                    export_dir, len(payload))
 
 
 def load_export(export_dir):

@@ -34,6 +34,9 @@ from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 logger = get_logger(__name__)
 
 FORMAT = "elasticdl_tpu_servable_v2"
+# Version resolution for the TF-Serving-style <base>/<N>/ layout lives
+# in serving.loader.resolve_export_dir — the ONE canonical scan (the
+# loader must stay framework-free, so everything imports from there).
 
 
 def _signature(tree):

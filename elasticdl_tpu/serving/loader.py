@@ -14,8 +14,36 @@ import os
 import numpy as np
 
 
+def resolve_export_dir(path):
+    """Accept either a direct export dir or a TF-Serving-style
+    versioned base (``path/<N>/`` numeric subdirs): return the dir
+    holding the highest COMPLETE version (manifest.json present — the
+    exporter writes it last).  Standalone re-implementation of
+    ``serving.export.latest_version`` so this file keeps importing
+    nothing from the framework."""
+    if os.path.isfile(os.path.join(path, "manifest.json")):
+        return path
+    best = None
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        entries = []
+    for entry in entries:
+        sub = os.path.join(path, entry)
+        if (entry.isdigit()
+                and os.path.isfile(os.path.join(sub, "manifest.json"))
+                and (best is None or int(entry) > best[0])):
+            best = (int(entry), sub)
+    if best is None:
+        raise FileNotFoundError(
+            "no manifest.json in %r and no complete numeric version "
+            "subdirectory under it" % path)
+    return best[1]
+
+
 class ServableModel:
     def __init__(self, export_dir):
+        export_dir = resolve_export_dir(export_dir)
         self.export_dir = export_dir
         with open(os.path.join(export_dir, "manifest.json")) as f:
             self.manifest = json.load(f)
@@ -40,7 +68,16 @@ class ServableModel:
         for name, (ids, _values) in self.embeddings.items():
             ids = np.asarray(ids)
             order = np.argsort(ids, kind="stable")
-            self._emb_index[name] = (ids[order], order)
+            srt = ids[order]
+            if len(srt) > 1:
+                # Dedupe keeping the LAST occurrence of a repeated id —
+                # the dict-rebuild path this index replaced had
+                # last-write-wins semantics, and a merged table may
+                # legitimately carry a later row for the same id.
+                keep = np.ones(len(srt), bool)
+                keep[:-1] = srt[1:] != srt[:-1]
+                srt, order = srt[keep], order[keep]
+            self._emb_index[name] = (srt, order)
         self._exported = None
 
     @property

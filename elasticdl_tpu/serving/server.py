@@ -24,11 +24,15 @@ import argparse
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from elasticdl_tpu.serving.loader import load_servable
+from elasticdl_tpu.serving.loader import (
+    load_servable,
+    resolve_export_dir,
+)
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -41,8 +45,13 @@ def _leaf_dtypes(signature):
     FLAT dict of arrays ({"inputs": {name: ...}}); deeper pytree inputs
     need the Python loader directly.
     """
-    if isinstance(signature, dict) and set(signature) >= {"shape",
-                                                          "dtype"}:
+    if (isinstance(signature, dict)
+            and isinstance(signature.get("shape"), (list, tuple))
+            and isinstance(signature.get("dtype"), str)):
+        # The leaf schema itself ({"shape": [...], "dtype": "..."}) —
+        # key presence alone is not enough: a dict-INPUT model whose
+        # feature names happen to include "shape"/"dtype" must not be
+        # misclassified as single-input.
         return {None: signature["dtype"]}
     if isinstance(signature, dict):
         return {
@@ -63,20 +72,83 @@ def _jsonable(outputs):
 
 
 class ModelEndpoint:
-    """One loaded servable + request/response marshalling."""
+    """One loaded servable + request/response marshalling.
 
-    def __init__(self, export_dir, name=None):
+    When ``export_dir`` is a versioned base (``<base>/<N>/`` numeric
+    subdirs, the TF-Serving layout the reference's deployment story
+    assumes — model_handler.py:242-269), the endpoint serves the latest
+    complete version and hot-swaps when a newer one appears: each
+    request re-scans at most once per ``poll_interval`` seconds (a
+    single listdir), loads the new servable OUTSIDE the execution lock,
+    and swaps it in under the lock, so in-flight predicts finish on the
+    old model and later ones see the new one.
+    """
+
+    def __init__(self, export_dir, name=None, poll_interval=2.0):
+        self.export_dir = export_dir
+        self.poll_interval = poll_interval
         self.model = load_servable(export_dir)
+        # Versioned mode iff the base itself is not a direct export —
+        # then the loader resolved a numeric subdir we can re-scan.
+        self._versioned = not os.path.isfile(
+            os.path.join(export_dir, "manifest.json"))
+        self._loaded_dir = self.model.export_dir
+        self._last_scan = time.monotonic()
         self.name = name or self.model.manifest.get("model_name") or (
             "model"
         )
         self._dtypes = _leaf_dtypes(
             self.model.manifest.get("input_signature", {})
         )
+        # (model, dtypes) as ONE tuple: a single attribute assignment is
+        # atomic, so a request never marshals with one version's dtypes
+        # and executes another version's model.
+        self._active = (self.model, self._dtypes)
         self._lock = threading.Lock()  # jax.export call is not
         # documented thread-safe; serialize execution, marshal outside
+        self._reload_lock = threading.Lock()  # scan/load/swap critical
+        # section — never held during predict execution
+
+    def maybe_reload(self):
+        """Hot-swap to a newer complete version, if one has appeared.
+
+        The steady-state cost is ONE listdir per poll_interval
+        (resolve_export_dir); the full servable load happens only when
+        the resolved dir actually changed.  The whole scan/load/swap
+        runs under a dedicated reload lock so concurrent request
+        threads can neither duplicate the load nor swap versions out
+        of order (the execution lock stays free for predicts on the
+        old model while a new one loads)."""
+        if not self._versioned:
+            return
+        if time.monotonic() - self._last_scan < self.poll_interval:
+            return
+        with self._reload_lock:
+            now = time.monotonic()
+            if now - self._last_scan < self.poll_interval:
+                return  # another thread just scanned
+            self._last_scan = now
+            try:
+                resolved = resolve_export_dir(self.export_dir)
+                if resolved == self._loaded_dir:
+                    return
+                fresh = load_servable(resolved)
+            except (OSError, ValueError) as e:
+                logger.warning("version rescan failed: %s", e)
+                return
+            dtypes = _leaf_dtypes(
+                fresh.manifest.get("input_signature", {}))
+            with self._lock:
+                self.model = fresh
+                self._dtypes = dtypes
+                self._active = (fresh, dtypes)
+                self._loaded_dir = fresh.export_dir
+        logger.info("reloaded model %r from %s (version %s)",
+                    self.name, fresh.export_dir,
+                    fresh.manifest.get("version"))
 
     def metadata(self):
+        self.maybe_reload()
         return {
             "model_version_status": [{
                 "version": str(self.model.manifest.get("version", 0)),
@@ -86,24 +158,27 @@ class ModelEndpoint:
         }
 
     def predict(self, body):
+        self.maybe_reload()
+        model, dtypes = self._active
         if "instances" in body:
-            dtype = self._dtypes.get(None, "float32")
+            dtype = dtypes.get(None, "float32")
             inputs = np.asarray(body["instances"], dtype=dtype)
         elif "inputs" in body:
             inputs = {
                 key: np.asarray(
-                    value, dtype=self._dtypes.get(key, "float32")
+                    value, dtype=dtypes.get(key, "float32")
                 )
                 for key, value in body["inputs"].items()
             }
         else:
             raise ValueError("body needs 'instances' or 'inputs'")
         with self._lock:
-            outputs = self.model.predict(inputs)
+            outputs = model.predict(inputs)
         return {"predictions": _jsonable(outputs)}
 
     def lookup(self, body):
-        vectors = self.model.lookup_embedding(
+        self.maybe_reload()
+        vectors = self._active[0].lookup_embedding(
             body["table"], np.asarray(body["ids"], np.int64)
         )
         return {"vectors": vectors.tolist()}
@@ -123,7 +198,10 @@ def build_server(endpoint, port=0, host="127.0.0.1"):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/v1/models/%s" % endpoint.name:
+            base = "/v1/models/%s" % endpoint.name
+            # TF Serving clients also GET <base>/metadata; serve it as
+            # an alias so their request shape really does carry over.
+            if self.path in (base, base + "/metadata"):
                 self._reply(200, endpoint.metadata())
             else:
                 self._reply(404, {"error": "unknown path %r" % self.path})

@@ -163,7 +163,8 @@ def _rollout_reference(params, cfg, prompt, max_new):
     return tokens
 
 
-@pytest.mark.parametrize("variant", ["dense", "gqa", "window"])
+@pytest.mark.parametrize(
+    "variant", ["dense", "gqa", "window", "gqa+window"])
 def test_generate_matches_full_forward(variant):
     """KV-cache decoding == full-forward greedy rollout, token for
     token (prefill + decode through the cache vs recomputing the whole
@@ -172,6 +173,10 @@ def test_generate_matches_full_forward(variant):
         "dense": CFG,
         "gqa": dataclasses.replace(CFG, num_kv_heads=2),
         "window": dataclasses.replace(CFG, window=8),
+        # Grouped decode einsum x window mask is the interaction with
+        # no other exact-match coverage (advisor r4).
+        "gqa+window": dataclasses.replace(CFG, num_kv_heads=2,
+                                          window=8),
     }[variant]
     params = tfm.init_params(jax.random.PRNGKey(7), cfg)
     prompt = make_tokens(b=2, t=5, seed=8)
