@@ -162,12 +162,27 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
     float(loss)  # fence
     _mark("warmup_done")
 
+    # Audit-grade samples (VERDICT r4 #8): time BLOCKS of iterations,
+    # each closed by a value fetch (the only real fence on this relay —
+    # block_until_ready doesn't fence it).  Per-iteration fences would
+    # distort the measurement at the relay's ~3.2 ms dispatch floor;
+    # per-block ones cost one fetch per `block` steps.
+    block = 5
+    blocks = []  # [iters_in_block, ms] — a trailing partial block
+    # records its true iteration count, not the nominal block size
     start = time.perf_counter()
+    t_block = start
+    done_at_fence = 0
     for k in range(iters):
         params, opt_state, loss = step(params, opt_state, xs, ys, ws)
-        if k % 5 == 4:
+        if (k + 1) % block == 0 or k == iters - 1:
+            float(loss)  # fence: close the block with a value fetch
+            now = time.perf_counter()
+            blocks.append([k + 1 - done_at_fence,
+                           round((now - t_block) * 1000.0, 2)])
+            t_block, done_at_fence = now, k + 1
             _mark("iter:%d/%d" % (k + 1, iters))
-    last_loss = float(loss)  # fence
+    last_loss = float(loss)
     elapsed = time.perf_counter() - start
     _mark("measured")
 
@@ -196,7 +211,32 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
             "last_loss": last_loss,
             "baseline": "145 img/s ResNet-50/ImageNet 1xP100 "
                         "(ftlib_benchmark.md:121)",
+            # Provenance (VERDICT r4 #8): raw per-block timings, device
+            # fingerprint, and env snapshot so a capture is auditable.
+            "samples": {"blocks": blocks,
+                        "format": "[iters, ms] per block"},
+            "device": _device_fingerprint(jax),
+            "env": _env_snapshot(),
         },
+    }
+
+
+def _device_fingerprint(jax_mod):
+    dev = jax_mod.devices()[0]
+    return {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", None),
+        "num_devices": len(jax_mod.devices()),
+        "jax_version": jax_mod.__version__,
+    }
+
+
+def _env_snapshot():
+    """The env knobs that can change what this benchmark measures."""
+    return {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith(("ELASTICDL_", "JAX_", "XLA_"))
+        and k != "ELASTICDL_BENCH_TOTAL_BUDGET"
     }
 
 
@@ -346,7 +386,9 @@ def _run_with_watchdog():
             result = last_json_line(stdout)
         except (subprocess.TimeoutExpired, OSError) as e:
             cpu_stash.kill()
-            cpu_stash.wait()
+            # communicate() (not wait()) drains and closes the PIPE fds
+            # so a long-lived harness doesn't leak them.
+            cpu_stash.communicate()
             failures.append("cpu stash: %s" % type(e).__name__)
         if result is not None:
             result["detail"]["note"] = (
@@ -356,7 +398,7 @@ def _run_with_watchdog():
             result["detail"]["tpu_failures"] = failures
     else:
         cpu_stash.kill()
-        cpu_stash.wait()
+        cpu_stash.communicate()  # drain + close PIPE fds, not bare wait
 
     if result is None:
         return {

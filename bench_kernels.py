@@ -106,9 +106,12 @@ def main():
             {"img_per_sec": res["value"],
              "ms_per_step": res["detail"]["ms_per_step"],
              "mfu": res["detail"]["mfu_estimate"],
-             "compile_secs": res["detail"]["compile_secs"]}
+             "compile_secs": res["detail"]["compile_secs"],
+             "samples": res["detail"].get("samples")}
             if res else {"error": reason}
         )
+        if res and "device" not in rows:
+            rows["device"] = res["detail"].get("device")
         print("resnet/%s: %s (%.0fs)" % (
             name, rows["resnet"][name], time.monotonic() - t0),
             file=sys.stderr, flush=True)
@@ -120,9 +123,12 @@ def main():
             {"tok_per_sec": res["value"],
              "ms_per_step": res["detail"]["ms_per_step"],
              "mfu": res["detail"]["mfu_estimate"],
-             "compile_secs": res["detail"]["compile_secs"]}
+             "compile_secs": res["detail"]["compile_secs"],
+             "samples": res["detail"].get("samples")}
             if res else {"error": reason}
         )
+        if res and "device" not in rows:
+            rows["device"] = res["detail"].get("device")
         print("lm/%s: %s (%.0fs)" % (
             name, rows["lm"][name], time.monotonic() - t0),
             file=sys.stderr, flush=True)
@@ -136,9 +142,12 @@ def main():
             {"tok_per_sec": res["value"],
              "ms_per_token_batch": res["detail"]["ms_per_token_batch"],
              "kv_heads": res["detail"]["kv_heads"],
-             "compile_secs": res["detail"]["compile_secs"]}
+             "compile_secs": res["detail"]["compile_secs"],
+             "samples": res["detail"].get("samples")}
             if res else {"error": reason}
         )
+        if res and "device" not in rows:
+            rows["device"] = res["detail"].get("device")
         print("decode/%s: %s (%.0fs)" % (
             name, rows["decode"][name], time.monotonic() - t0),
             file=sys.stderr, flush=True)

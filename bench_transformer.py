@@ -104,11 +104,26 @@ def run_bench(warmup=2, iters=10):
         params, opt_state, loss = step(params, opt_state)
     float(loss)
 
+    # Per-block samples (VERDICT r4 #8): fence every 2 steps with a
+    # value fetch (the only real fence on this relay).  Each sample is
+    # [iters_in_block, ms] so a trailing partial block stays truthful.
+    block = 2
+    blocks = []
     start = time.perf_counter()
-    for _ in range(iters_):
+    t_block = start
+    done_at_fence = 0
+    for k in range(iters_):
         params, opt_state, loss = step(params, opt_state)
+        if (k + 1) % block == 0 or k == iters_ - 1:
+            float(loss)
+            now = time.perf_counter()
+            blocks.append([k + 1 - done_at_fence,
+                           round((now - t_block) * 1000.0, 2)])
+            t_block, done_at_fence = now, k + 1
     last_loss = float(loss)
     elapsed = time.perf_counter() - start
+    samples = {"blocks": blocks, "format": "[iters, ms] per block"}
+    device, env_snap = _provenance(jax)
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * iters_ / elapsed
@@ -135,8 +150,19 @@ def run_bench(warmup=2, iters=10):
             "flash_bwd": os.environ.get("ELASTICDL_FLASH_BWD", "pallas"),
             "remat": str(remat),
             "xent_chunk": xent_chunk,
+            "samples": samples,
+            "device": device,
+            "env": env_snap,
         },
     }
+
+
+def _provenance(jax_mod):
+    """(device fingerprint, env snapshot) — shared with bench.py
+    (VERDICT r4 #8)."""
+    import bench as _bench
+
+    return _bench._device_fingerprint(jax_mod), _bench._env_snapshot()
 
 
 def run_decode_bench(batch=8, prompt_len=128, new_tokens=128):
@@ -185,11 +211,17 @@ def run_decode_bench(batch=8, prompt_len=128, new_tokens=128):
     int(out[0, -1])  # fence (relay does not fence block_until_ready)
     compile_secs = time.perf_counter() - compile_start
     iters = 3
+    blocks = []
     start = time.perf_counter()
+    t_block = start
     for _ in range(iters):
         out = gen(params, prompt)
-    int(out[0, -1])
+        int(out[0, -1])  # fence each full generate
+        now = time.perf_counter()
+        blocks.append([1, round((now - t_block) * 1000.0, 2)])
+        t_block = now
     elapsed = time.perf_counter() - start
+    device, env_snap = _provenance(jax)
 
     tok_per_sec = batch * new_tokens * iters / elapsed
     return {
@@ -206,6 +238,10 @@ def run_decode_bench(batch=8, prompt_len=128, new_tokens=128):
             "ms_per_token_batch": round(
                 1000.0 * elapsed / (new_tokens * iters), 3),
             "compile_secs": round(compile_secs, 1),
+            "samples": {"blocks": blocks,
+                        "format": "[generates, ms] per block"},
+            "device": device,
+            "env": env_snap,
         },
     }
 

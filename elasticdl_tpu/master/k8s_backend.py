@@ -172,7 +172,8 @@ class K8sWorkerBackend:
     def _apply_spec_hook(self, manifest, hook_name):
         return apply_spec_hook(self._cluster_spec, manifest, hook_name)
 
-    def pod_manifest(self, worker_id, master_addr, slot=None):
+    def pod_manifest(self, worker_id, master_addr, slot=None,
+                     extra_env=None):
         slot = worker_id if slot is None else slot
         manifest = {
             "apiVersion": "v1",
@@ -196,6 +197,9 @@ class K8sWorkerBackend:
                     "env": [
                         {"name": "MASTER_ADDR", "value": master_addr},
                         {"name": "WORKER_ID", "value": str(worker_id)},
+                    ] + [
+                        {"name": k, "value": str(v)}
+                        for k, v in sorted((extra_env or {}).items())
                     ],
                     "resources": {"requests": dict(self._resources)},
                 }],
@@ -243,13 +247,26 @@ class K8sWorkerBackend:
 
     # -- WorkerManager backend surface --------------------------------------
 
-    def launch(self, worker_id, master_addr, slot=None):
+    def slot_addresses(self, num_workers, port=50002):
+        """Stable host:port per worker SLOT — the slot services point at
+        whichever pod currently fills the slot, so these addresses stay
+        valid across relaunches.  Feed them to
+        ``cluster_spec_env.make_tf_config_fn`` for foreign-runtime
+        cluster specs (reference pod_manager.py:405-422)."""
+        return [
+            "%s.%s.svc:%d" % (self._service_name(slot), self._namespace,
+                              port)
+            for slot in range(num_workers)
+        ]
+
+    def launch(self, worker_id, master_addr, slot=None, extra_env=None):
         """``slot`` is the stable replica slot (WorkerHandle.slot): on a
         relaunch it is the ORIGINAL slot id, so the slot's service keeps
         re-pointing at each replacement no matter how many times the
         worker dies."""
         slot = worker_id if slot is None else slot
-        pod = self.pod_manifest(worker_id, master_addr, slot=slot)
+        pod = self.pod_manifest(worker_id, master_addr, slot=slot,
+                                extra_env=extra_env)
         self._core.create_namespaced_pod(self._namespace, pod)
         if slot != worker_id:
             # Keep the slot's service and re-point it at the replacement

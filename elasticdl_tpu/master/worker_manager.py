@@ -43,10 +43,11 @@ class ProcessWorkerBackend:
         self._worker_args = worker_args or []
         self._env = env or {}
 
-    def launch(self, worker_id, master_addr, slot=None):
+    def launch(self, worker_id, master_addr, slot=None, extra_env=None):
         del slot  # process workers have no service to re-point
         env = dict(os.environ)
         env.update(self._env)
+        env.update(extra_env or {})
         env["MASTER_ADDR"] = master_addr
         env["WORKER_ID"] = str(worker_id)
         # Workers in drills run on CPU so N of them fit on one host.
@@ -79,11 +80,17 @@ class WorkerManager:
         num_workers,
         max_relaunch_count=3,
         relaunch_on_failure=True,
+        cluster_env_fn=None,
     ):
         self._backend = backend
         self._num_workers = num_workers
         self._max_relaunch = max_relaunch_count
         self._relaunch_on_failure = relaunch_on_failure
+        # Optional foreign-runtime cluster-spec hook: (worker_id, slot)
+        # -> {env} injected into every (re)launch, e.g. a TF_CONFIG
+        # built by cluster_spec_env.make_tf_config_fn (reference
+        # pod_manager.py:405-422).
+        self._cluster_env_fn = cluster_env_fn
         self._master_addr = None
         self._lock = threading.Lock()
         self._workers = {}          # worker_id -> WorkerHandle
@@ -115,8 +122,13 @@ class WorkerManager:
         with self._lock:
             worker_id = self._next_worker_id
             self._next_worker_id += 1
+            kwargs = {}
+            if self._cluster_env_fn is not None:
+                kwargs["extra_env"] = self._cluster_env_fn(
+                    worker_id, worker_id if slot is None else slot
+                )
             ref = self._backend.launch(
-                worker_id, self._master_addr, slot=slot
+                worker_id, self._master_addr, slot=slot, **kwargs
             )
             handle = WorkerHandle(worker_id, ref, slot=slot)
             handle.status = ws.PENDING

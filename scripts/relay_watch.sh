@@ -1,0 +1,23 @@
+#!/bin/bash
+# Loop: probe the TPU relay with a capped subprocess; exit 0 the moment
+# it answers so the caller is notified. Log history to .relay_probe.log.
+# NOTE: success = the probe PRINTED its OK line (never trust pipeline rc).
+LOG=/root/repo/.relay_probe.log
+for i in $(seq 1 200); do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 150 python -c "
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((128,128)); v = float((x@x).sum())
+print('PROBE-OK', d[0].platform, v, flush=True)
+" 2>&1 | grep "PROBE-OK" | head -1)
+  echo "$ts probe$i out=[$out]" >> "$LOG"
+  if [ -n "$out" ]; then
+    echo "RELAY HEALTHY at $ts: $out"
+    exit 0
+  fi
+  sleep 120
+done
+echo "RELAY never answered"
+exit 1

@@ -251,6 +251,23 @@ def test_relaunched_high_priority_slot_keeps_protection():
     assert pod["spec"]["priorityClassName"] == "lo"
 
 
+def test_pod_manifest_carries_extra_env_and_slot_addresses():
+    """The foreign-runtime cluster-spec hook on the k8s backend: the
+    TF_CONFIG (or any) extra env rides the pod manifest, and
+    slot_addresses() yields the stable per-slot service DNS names to
+    build it from (reference pod_manager.py:405-422)."""
+    _, backend = make_backend()
+    addrs = backend.slot_addresses(2)
+    assert addrs == ["job-worker-0.default.svc:50002",
+                     "job-worker-1.default.svc:50002"]
+    pod = backend.pod_manifest(
+        1, "m:1", extra_env={"TF_CONFIG": '{"task": 1}'})
+    env = {e["name"]: e["value"]
+           for e in pod["spec"]["containers"][0]["env"]}
+    assert env["TF_CONFIG"] == '{"task": 1}'
+    assert env["WORKER_ID"] == "1"
+
+
 def test_worker_manager_drives_k8s_relaunch_end_to_end():
     """WorkerManager + K8sWorkerBackend against the fake API: preempt a
     pod (delete it), watch the DELETED -> relaunch flow create a fresh

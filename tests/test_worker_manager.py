@@ -127,6 +127,46 @@ def test_oom_killed_worker_not_relaunched():
     mgr.stop()
 
 
+def test_cluster_env_fn_emits_tf_config_per_slot():
+    """The foreign-runtime cluster-spec hook (reference
+    pod_manager.py:405-422): every launch carries a TF_CONFIG built
+    from the manager's cluster view, and a RELAUNCHED worker inherits
+    its slot's task index — the identity the foreign runtime knows it
+    by — not its fresh worker id."""
+    import json
+
+    from elasticdl_tpu.master.cluster_spec_env import make_tf_config_fn
+
+    class EnvRecordingBackend(FakeBackend):
+        def __init__(self):
+            super().__init__()
+            self.envs = {}
+
+        def launch(self, worker_id, master_addr, slot=None,
+                   extra_env=None):
+            self.envs[worker_id] = dict(extra_env or {})
+            return super().launch(worker_id, master_addr, slot=slot)
+
+    hosts = ["w-0.ns.svc:50002", "w-1.ns.svc:50002"]
+    backend = EnvRecordingBackend()
+    mgr = WorkerManager(
+        backend, num_workers=2,
+        cluster_env_fn=make_tf_config_fn(hosts, ps_hosts=["ps0:2222"]),
+    )
+    mgr.set_master_addr("localhost:0")
+    mgr.start()
+    for wid in (0, 1):
+        cfg = json.loads(backend.envs[wid]["TF_CONFIG"])
+        assert cfg["cluster"] == {"worker": hosts, "ps": ["ps0:2222"]}
+        assert cfg["task"] == {"type": "worker", "index": wid}
+
+    backend.refs[0].finish(1)  # crash slot 0's worker
+    assert wait_until(lambda: 2 in backend.envs)
+    cfg = json.loads(backend.envs[2]["TF_CONFIG"])
+    assert cfg["task"]["index"] == 0  # slot identity, not worker id 2
+    mgr.stop()
+
+
 def test_preempt_drill_is_not_done_window():
     """Between the SIGKILL and the relaunch, all_workers_done must stay
     False (relaunch_pending masks the dead-but-recovering window), or the
