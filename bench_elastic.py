@@ -26,10 +26,14 @@ os.environ["ELASTICDL_TPU_PLATFORM"] = _PLATFORM
 os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 
-def run_drill(num_workers=2, records=4096):
+def run_drill(num_workers=2, records=4096, worker_env=None,
+              deadline_secs=180):
+    """One preemption drill.  ``worker_env`` overrides the worker
+    process env — the TPU legs use it to aim workers at the real chip
+    and at a persistent compilation cache (see ``main``)."""
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")  # master stays on CPU
 
     from elasticdl_tpu.data.factory import create_data_reader
     from elasticdl_tpu.master.master import Master
@@ -52,7 +56,8 @@ def run_drill(num_workers=2, records=4096):
         "--num_minibatches_per_task", "4", "--num_epochs", "2",
     ]
     worker_manager = WorkerManager(
-        ProcessWorkerBackend(worker_args=worker_args),
+        ProcessWorkerBackend(worker_args=worker_args,
+                             env=worker_env or {}),
         num_workers=num_workers,
     )
     master = Master(task_manager, worker_manager=worker_manager)
@@ -68,7 +73,7 @@ def run_drill(num_workers=2, records=4096):
     runner.start()
 
     # wait until training is underway (a few tasks done)
-    deadline = time.time() + 180
+    deadline = time.time() + deadline_secs
     while time.time() < deadline:
         if task_manager.counts()["completed"][pb.TRAINING] >= 2:
             break
@@ -82,7 +87,7 @@ def run_drill(num_workers=2, records=4096):
     # relaunch time: first launch event after the kill
     relaunch_secs = None
     recovery_secs = None
-    deadline = time.time() + 180
+    deadline = time.time() + deadline_secs
     while time.time() < deadline:
         if relaunch_secs is None:
             later = [t for wid, t in launch_times if t > t_kill]
@@ -94,24 +99,134 @@ def run_drill(num_workers=2, records=4096):
             break
         time.sleep(0.05)
 
-    runner.join(timeout=240)
     master.stop()
+    runner.join(timeout=30)
     counts = task_manager.counts()
     return {
-        "metric": "elastic_recovery_time",
-        "value": round(recovery_secs, 3) if recovery_secs else None,
-        "unit": "seconds",
-        "detail": {
-            "relaunch_secs": round(relaunch_secs, 3)
-            if relaunch_secs else None,
-            "tasks_failed_permanently": counts["failed"][pb.TRAINING],
-            "tasks_completed": counts["completed"][pb.TRAINING],
-            "note": "preemption -> first task completed afterwards; "
-                    "CPU workers (control-plane metric)",
-        },
+        "recovery_secs": round(recovery_secs, 3) if recovery_secs
+        else None,
+        "relaunch_secs": round(relaunch_secs, 3) if relaunch_secs
+        else None,
+        "tasks_failed_permanently": counts["failed"][pb.TRAINING],
+        "tasks_completed": counts["completed"][pb.TRAINING],
     }
 
 
+def main():
+    """Three legs (VERDICT r4 #3 — BASELINE.json metric #3 and SURVEY
+    §7's named hard part, re-init -> re-shard -> re-compile):
+
+    cpu        control-plane drill, 2 CPU workers (state flows only)
+    tpu_cold   1 TPU worker, EMPTY compilation cache: preemption ->
+               replacement boots, re-inits the relay backend,
+               RE-COMPILES the train step, completes a task
+    tpu_warm   same with the persistent cache already populated (by
+               tpu_cold) — the production recovery path
+
+    The TPU legs are probe-gated (a wedged relay costs one <=90 s
+    probe, never a full drill) and each runs in its own watchdog'd
+    subprocess.  Headline value = tpu_warm recovery when measured
+    (else cpu), with every leg in the detail.
+    """
+    import shutil
+    import subprocess
+
+    budget = int(os.environ.get("ELASTICDL_ELASTIC_BENCH_BUDGET",
+                                "900"))
+    t0 = time.monotonic()
+
+    def remaining():
+        return budget - (time.monotonic() - t0) - 10
+
+    detail = {"platform_legs": {}}
+    legs = detail["platform_legs"]
+    legs["cpu"] = run_drill()
+    legs["cpu"]["note"] = "2 CPU process workers; control-plane cost"
+
+    import bench as _bench  # probe + provenance helpers
+
+    tpu_env_base = {
+        # undo this module's CPU pin for the worker processes only
+        "ELASTICDL_TPU_PLATFORM": "", "JAX_PLATFORMS": "",
+        "ELASTICDL_FUSED_GN": "off",
+    }
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache_elastic")
+    ok, reason = (False, "skipped: no budget")
+    if remaining() > 240:
+        # The probe must bypass this module's CPU pin (empty strings
+        # undo it for the subprocess) and must have reached a REAL
+        # accelerator — "PROBE-OK cpu" is a false positive here.
+        stdout, sub_reason = _bench._run_sub(
+            ["--probe"], min(90, int(remaining() - 120)),
+            env=tpu_env_base,
+        )
+        if stdout and "PROBE-OK" in stdout and (
+            "PROBE-OK cpu" not in stdout
+        ):
+            ok = True
+        else:
+            reason = sub_reason or "probe answered from cpu"
+    if ok:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        for leg, note in (
+            ("tpu_cold", "1 TPU worker, empty compile cache: full "
+                         "re-init + re-compile on recovery"),
+            ("tpu_warm", "1 TPU worker, warm persistent compile "
+                         "cache: the production recovery path"),
+        ):
+            if remaining() < 180:
+                legs[leg] = {"error": "skipped, %ds left"
+                             % int(remaining())}
+                continue
+            env = dict(tpu_env_base,
+                       JAX_COMPILATION_CACHE_DIR=cache_dir)
+            code = (
+                "import json, bench_elastic as b; "
+                "print('LEG ' + json.dumps(b.run_drill("
+                "num_workers=1, worker_env=%r, deadline_secs=300)))"
+                % (env,)
+            )
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", code],
+                    capture_output=True, text=True,
+                    timeout=max(60, int(remaining())),
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                row = next(
+                    (json.loads(ln[4:]) for ln in
+                     proc.stdout.splitlines() if ln.startswith("LEG ")),
+                    None,
+                )
+                legs[leg] = row or {
+                    "error": "no LEG line (exit %d): %s"
+                    % (proc.returncode, (proc.stderr or "")[-200:])}
+            except subprocess.TimeoutExpired:
+                legs[leg] = {"error": "timed out"}
+            if isinstance(legs[leg], dict) and "recovery_secs" in (
+                legs[leg]
+            ):
+                legs[leg]["note"] = note
+    else:
+        legs["tpu"] = {"error": "relay probe failed: %s" % reason}
+
+    warm = legs.get("tpu_warm", {}).get("recovery_secs")
+    value = warm if warm is not None else legs["cpu"]["recovery_secs"]
+    print(json.dumps({
+        "metric": "elastic_recovery_time",
+        "value": value,
+        "unit": "seconds",
+        "vs_baseline": None,
+        "detail": dict(
+            detail,
+            headline_leg="tpu_warm" if warm is not None else "cpu",
+            env=_bench._env_snapshot(),
+            bench_wall_secs=round(time.monotonic() - t0, 1),
+        ),
+    }))
+    return 0
+
+
 if __name__ == "__main__":
-    print(json.dumps(run_drill()))
-    sys.exit(0)
+    sys.exit(main())

@@ -83,7 +83,18 @@ class ElasticCollectiveController:
         check_secs=DEFAULT_SECS_TO_CHECK_RENDEZVOUS,
         mesh_builder=None,
         max_retries=3,
+        epoch_wait_secs=60.0,
+        check_steps=None,
     ):
+        """``check_steps``: re-check the rendezvous every N wrapped
+        calls instead of every ``check_secs`` seconds.  Step-count
+        cadence is the SPMD-safe choice for multi-process collectives:
+        every member of an epoch enters it at the same logical point
+        and runs the same step sequence, so all members observe a new
+        epoch at the SAME collective index and leave the old world
+        together — a wall-clock cadence lets one rank leave while a
+        peer is already blocked inside a collective the leaver will
+        never join."""
         self._mc = master_client
         self._trainer = trainer
         self._shard_service = data_shard_service
@@ -91,6 +102,9 @@ class ElasticCollectiveController:
         self._check_secs = check_secs
         self._mesh_builder = mesh_builder
         self._max_retries = max_retries
+        self._epoch_wait_secs = epoch_wait_secs
+        self._check_steps = check_steps
+        self._steps_since_check = 0
         self._rendezvous = RendezvousManager(master_client)
         self._last_check = 0.0
         self._first_init_done = False
@@ -103,6 +117,13 @@ class ElasticCollectiveController:
             "world epoch %d: rank=%d world=%d",
             rdzv.rendezvous_id, rdzv.rank, rdzv.world_size,
         )
+        if self._first_init_done and hasattr(self._trainer,
+                                             "snapshot_to_host"):
+            # Re-forming a master-coordinated world clears XLA backends
+            # (parallel/distributed.py), which invalidates every device
+            # array of the old epoch — pull state to host FIRST, while
+            # the local backend is still alive.
+            self._trainer.snapshot_to_host()
         if self._mesh_builder is not None:
             # Multi-host path: the builder may call
             # jax.distributed.initialize(coordinator, world, rank) and
@@ -119,8 +140,14 @@ class ElasticCollectiveController:
 
     def init_world_if_needed(self, force=False):
         now = time.time()
-        if not force and now - self._last_check < self._check_secs:
-            return False
+        if not force:
+            if self._check_steps is not None:
+                if (self._first_init_done
+                        and self._steps_since_check < self._check_steps):
+                    return False
+            elif now - self._last_check < self._check_secs:
+                return False
+        self._steps_since_check = 0
         self._last_check = now
         changed = self._rendezvous.poll(wait=not self._first_init_done)
         if changed or not self._first_init_done:
@@ -129,11 +156,30 @@ class ElasticCollectiveController:
             return True
         return False
 
+    def await_new_epoch(self, timeout=60.0, poll_secs=0.5):
+        """Block until the master commits a DIFFERENT epoch, then
+        rebuild for it.  The recovery path after an in-band collective
+        failure: the failed world is dead, so retrying before the
+        master removes the lost peer and re-forms membership would
+        just fail again (reference allreduce_trainer.py:77-91 —
+        Horovod survivors wait on a new rendezvous).  Returns True if
+        a new epoch arrived."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._rendezvous.poll(wait=False):
+                self._reinit_world()
+                self._last_check = time.time()
+                self._steps_since_check = 0
+                return True
+            time.sleep(poll_secs)
+        return False
+
     # -- loop driver ----------------------------------------------------------
 
     def elastic_run(self, func):
         @functools.wraps(func)
         def wrapper(*args, **kwargs):
+            self._steps_since_check += 1
             self.init_world_if_needed()
             err = None
             for _ in range(self._max_retries):
@@ -148,7 +194,19 @@ class ElasticCollectiveController:
                         "step failed (%s); re-rendezvousing and retrying", e
                     )
                     time.sleep(1.0)
-                    self.init_world_if_needed(force=True)
+                    # In a multi-process world, prefer waiting for a
+                    # NEW epoch: the failed world cannot succeed until
+                    # the master removes the lost peer.  Fall back to a
+                    # forced re-init if none arrives (transient error,
+                    # membership unchanged) — also the whole story for
+                    # single-process worlds.
+                    recovered = (
+                        self._rendezvous.world_size > 1
+                        and self.await_new_epoch(
+                            timeout=self._epoch_wait_secs)
+                    )
+                    if not recovered:
+                        self.init_world_if_needed(force=True)
             raise RuntimeError(
                 "step failed after %d re-rendezvous retries"
                 % self._max_retries

@@ -19,9 +19,18 @@ logger = get_logger(__name__)
 
 
 class RendezvousServer:
-    def __init__(self, grace_secs=2.0):
+    def __init__(self, grace_secs=2.0, coordinator_factory=None):
+        """``coordinator_factory(world_size) -> addr`` (optional): run
+        at every epoch commit to stand up that epoch's coordination
+        plane — in production ``MasterCoordinationService.start_epoch``
+        (parallel/distributed.py), which keeps the JAX coordination
+        service on the MASTER so worker churn can never strand the
+        survivors.  Without a factory the address set via
+        ``set_coordinator_addr`` is advertised unchanged (legacy:
+        worker 0 hosts the service)."""
         self._lock = threading.Lock()
         self._grace_secs = grace_secs
+        self._coordinator_factory = coordinator_factory
         self._cur_hosts = []     # committed world, sorted by join order
         self._next_hosts = []    # pending world
         self._rendezvous_id = 0
@@ -62,11 +71,31 @@ class RendezvousServer:
             and self._last_change is not None
             and time.time() - self._last_change >= self._grace_secs
         ):
-            self._cur_hosts = list(self._next_hosts)
+            new_hosts = list(self._next_hosts)
+            addr = self._coordinator_addr
+            if self._coordinator_factory is not None:
+                # Stand the epoch's coordination plane up BEFORE
+                # publishing the epoch: a factory failure (port grabbed
+                # between probe and bind, resource exhaustion) must not
+                # commit a new rendezvous_id pointing at the previous
+                # epoch's address.  Deferring re-arms the grace window,
+                # so the commit retries.
+                try:
+                    addr = self._coordinator_factory(len(new_hosts))
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "coordinator factory failed (%s); deferring "
+                        "epoch commit", e,
+                    )
+                    self._last_change = time.time()
+                    return
+            self._cur_hosts = new_hosts
             self._rendezvous_id += 1
+            self._coordinator_addr = addr
             logger.info(
-                "rendezvous epoch %d: world=%s",
+                "rendezvous epoch %d: world=%s coordinator=%s",
                 self._rendezvous_id, self._cur_hosts,
+                self._coordinator_addr,
             )
 
     def get_comm_rank(self, host):

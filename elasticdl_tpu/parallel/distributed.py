@@ -1,16 +1,42 @@
-"""Multi-host collective bootstrap.
+"""Multi-host collective bootstrap — elastic, master-coordinated.
 
 The reference's AllReduce path rebuilds a Horovod/Gloo ring from the
-master-hosted rendezvous (SURVEY §2.12).  The TPU-native equivalent: the
-master's rendezvous epoch hands every worker (rank, world_size,
-coordinator_addr); workers (re-)run ``jax.distributed.initialize`` against
-the epoch's coordinator and rebuild the global mesh.  This module is the
-glue the elastic controller's ``mesh_builder`` hook plugs in
-(api/controller.py: ElasticCollectiveController(mesh_builder=...)).
+master-hosted rendezvous (SURVEY §2.12); a worker failure surfaces
+IN-BAND as a HorovodInternalError and the survivors re-rendezvous
+(elasticdl/python/worker/allreduce_trainer.py:77-91).  The TPU-native
+redesign here keeps the same control relationship but swaps every
+mechanism:
+
+ - The MASTER hosts the JAX coordination service
+   (``MasterCoordinationService``), one fresh service per rendezvous
+   epoch on a fresh port.  Workers are *clients only* — a dying worker
+   can never take the coordination plane down with it (in stock
+   ``jax.distributed`` the service lives in process 0, so losing that
+   worker strands everyone else).
+ - Workers connect with the coordination client in ``recoverable``
+   mode: a peer's death surfaces as an ordinary exception from the
+   failed collective (the in-band signal) instead of the default
+   behavior of TERMINATING the surviving process from the error-poll
+   thread.
+ - Re-forming the world is a first-class operation:
+   ``initialize_from_rendezvous`` disconnects, clears XLA backends (a
+   new process count changes the global device world, so compiled
+   programs and device arrays from the old epoch are discarded), and
+   reconnects against the new epoch's service.  Callers must snapshot
+   state to host first (CollectiveTrainer.snapshot_to_host).
+
+Address convention: a master-hosted coordination service is advertised
+as ``jaxsvc://host:port`` so workers know to client-only connect; a
+bare ``host:port`` keeps the legacy ``jax.distributed.initialize``
+behavior (worker 0 hosts the service) for single-epoch jobs.
 
 Single-process worlds skip distributed init entirely, so the same code
 path runs in tests and single-host jobs.
 """
+
+import os
+import socket
+import threading
 
 import jax
 
@@ -19,11 +45,218 @@ from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+JAXSVC_PREFIX = "jaxsvc://"
+
+
+def _heartbeat_secs():
+    """Peer-death detection latency knob (service + client side)."""
+    return int(os.environ.get("ELASTICDL_COLLECTIVE_HEARTBEAT", "10"))
+
+
+class MasterCoordinationService:
+    """Master-side JAX coordination service, one instance per epoch.
+
+    ``start_epoch(world_size)`` starts a fresh service on a free port
+    and returns its advertised ``jaxsvc://host:port`` address.  The
+    PREVIOUS epoch's service is reaped on a timer after ``reap_secs``
+    (default 30): survivors of a membership change must detach from it
+    with an explicit client shutdown, and that RPC is only safe while
+    the old service is still up — the client's heartbeat/shutdown
+    failure paths TERMINATE the worker process from C++ (and this
+    jaxlib's missed_heartbeat_callback binding raises std::bad_cast
+    for every Python callable, so the fatal path cannot be
+    intercepted).  ``reap_secs`` therefore must exceed the workers'
+    worst-case epoch-discovery time (their rendezvous check cadence
+    plus one step)."""
+
+    def __init__(self, host="localhost", shutdown_timeout=3,
+                 reap_secs=30.0):
+        self._host = host
+        self._shutdown_timeout = shutdown_timeout
+        self._reap_secs = reap_secs
+        self._service = None
+        self._reapers = []
+
+    def start_epoch(self, world_size):
+        from jax._src.lib import _jax
+
+        previous = self._service
+        if previous is not None:
+            reaper = threading.Timer(
+                self._reap_secs, self._stop_service, args=(previous,)
+            )
+            reaper.daemon = True
+            reaper.start()
+            # Prune fired timers — a long-lived elastic master churns
+            # through many epochs and must not accumulate dead Timers
+            # (each pins its old-service arg until GC).
+            self._reapers = [r for r in self._reapers if r.is_alive()]
+            self._reapers.append(reaper)
+            self._service = None
+        if world_size <= 0:
+            return ""
+        service = None
+        last_err = None
+        for _attempt in range(3):
+            # The probe socket is closed before the service binds, so
+            # another process can grab the port in between (and the
+            # service binds [::] while the probe used the default
+            # family) — retry with a fresh port on a bind failure.
+            try:
+                probe = socket.socket(socket.AF_INET6)
+            except OSError:
+                probe = socket.socket()
+            with probe:
+                probe.bind(("", 0))
+                port = probe.getsockname()[1]
+            try:
+                service = _jax.get_distributed_runtime_service(
+                    "[::]:%d" % port, world_size,
+                    heartbeat_timeout=_heartbeat_secs(),
+                    shutdown_timeout=self._shutdown_timeout,
+                )
+                break
+            except Exception as e:  # noqa: BLE001 — port stolen
+                last_err = e
+        if service is None:
+            raise RuntimeError(
+                "could not bind a coordination service port"
+            ) from last_err
+        self._service = service
+        addr = "%s%s:%d" % (JAXSVC_PREFIX, self._host, port)
+        logger.info("coordination service for world=%d at %s",
+                    world_size, addr)
+        return addr
+
+    @staticmethod
+    def _stop_service(service):
+        try:
+            service.shutdown()
+        except Exception as e:  # noqa: BLE001 — old world died messily
+            logger.info("old coordination service shutdown: %s", e)
+
+    def stop(self):
+        for reaper in self._reapers:
+            reaper.cancel()
+        self._reapers = []
+        if self._service is not None:
+            self._stop_service(self._service)
+            self._service = None
+
+
+def _client_connect(rank, world_size, host_port):
+    """Client-only attach to a master-hosted coordination service."""
+    from jax._src import distributed as jdist
+    from jax._src.lib import _jax
+
+    state = jdist.global_state
+    state.coordinator_address = host_port
+    state.process_id = rank
+    state.num_processes = world_size
+    state.client = _jax.get_distributed_runtime_client(
+        host_port, rank,
+        init_timeout=int(os.environ.get(
+            "ELASTICDL_COLLECTIVE_INIT_TIMEOUT", "60")),
+        heartbeat_timeout=_heartbeat_secs(),
+        shutdown_timeout=3,
+        use_compression=True,
+        # A peer dying must surface as a catchable collective error in
+        # the survivors, not terminate them from the error-poll thread.
+        recoverable=True,
+        # Disconnect is DROP-only (below): a ShutdownTask RPC against
+        # an epoch whose service the master already replaced LOG(FATAL)s
+        # in the client — never send it, never let a destructor send it.
+        # (The default missed-heartbeat handler also terminates, but the
+        # drop-only disconnect frees the old client well inside the
+        # heartbeat window, so it never fires on a dead epoch.)
+        shutdown_on_destruction=False,
+    )
+    state.client.connect()
+    state.initialize_preemption_sync_manager()
+
+
+def _client_disconnect():
+    """Detach from the old epoch's (still-running) service.
+
+    The explicit ``client.shutdown()`` is what stops the client's
+    heartbeat thread — merely dropping the Python reference does not
+    (the backend caches and the thread itself keep the C++ object
+    alive), and a live heartbeat against a dead service terminates the
+    process.  This is why the master REAPS old services on a delay
+    (MasterCoordinationService) instead of at commit: the shutdown RPC
+    must land on a live service."""
+    from jax._src import distributed as jdist
+
+    state = jdist.global_state
+    if state.preemption_sync_manager is not None:
+        try:
+            state.preemption_sync_manager.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        state.preemption_sync_manager = None
+    if state.client is not None:
+        try:
+            state.client.shutdown()
+        except Exception as e:  # noqa: BLE001 — epoch died messily
+            logger.info("coordination client shutdown: %s", e)
+        state.client = None
+
+
+def _discard_old_world():
+    """Drop every artifact of the previous epoch's global world: the
+    jit/pjit caches and XLA backends hold compiled programs, device
+    arrays, AND references to the old distributed client — all invalid
+    (or process-terminating, via the client's heartbeat thread) once
+    the epoch is gone."""
+    import gc
+
+    import jax.extend.backend
+
+    jax.clear_caches()
+    jax.extend.backend.clear_backends()
+    gc.collect()
+
+
+def _reset_to_single_process():
+    """Shrink to a clean single-process world (the last survivor, or a
+    world-1 epoch): disconnect, discard the old world, and restore the
+    default local identity so sharding sees process 0 of 1."""
+    from jax._src import distributed as jdist
+
+    state = jdist.global_state
+    if state.client is None:
+        return
+    _client_disconnect()
+    state.coordinator_address = None
+    state.process_id = 0
+    state.num_processes = 1
+    _discard_old_world()
+    logger.info("collective world left: single-process mode restored")
+
 
 def initialize_from_rendezvous(rank, world_size, coordinator_addr):
-    """(Re-)initialize jax.distributed for a new membership epoch."""
+    """(Re-)initialize the collective runtime for a membership epoch.
+
+    Master-hosted addresses (``jaxsvc://``) use the elastic client-only
+    path and support REPEATED calls with different worlds: each call
+    disconnects, clears XLA backends (device arrays and compiled
+    programs of the old world are invalidated — snapshot to host
+    first), and reconnects.  Bare addresses keep the legacy
+    ``jax.distributed.initialize`` semantics.
+    """
     if world_size <= 1 or not coordinator_addr:
+        _reset_to_single_process()
         return False
+    if coordinator_addr.startswith(JAXSVC_PREFIX):
+        host_port = coordinator_addr[len(JAXSVC_PREFIX):]
+        _client_disconnect()
+        _discard_old_world()
+        _client_connect(rank, world_size, host_port)
+        logger.info(
+            "collective world joined (client-only): rank %d / %d via %s",
+            rank, world_size, host_port,
+        )
+        return True
     try:
         jax.distributed.shutdown()
     except Exception:  # noqa: BLE001 — not initialized yet

@@ -99,6 +99,36 @@ class CollectiveTrainer(Trainer):
 
     # -- mesh / jit management ---------------------------------------------
 
+    def snapshot_to_host(self):
+        """Pull params + optimizer state to host numpy, in place.
+
+        Called by the elastic controller BEFORE re-forming a
+        master-coordinated world: the re-init clears XLA backends, which
+        invalidates every device array of the old epoch.  Replicated
+        leaves always survive (each process holds a full copy).  A
+        ZeRO-1-sharded optimizer leaf is only partially addressable —
+        when a peer died, its shard died with it, so the leaf cannot be
+        reassembled: optimizer state is re-initialized from the (still
+        complete) params, and training resumes with fresh moments (the
+        same information loss the reference accepts when a Horovod
+        restart reloads the last checkpoint without optimizer slots)."""
+        try:
+            self._params = to_numpy(self._params)
+        except Exception as e:
+            raise RuntimeError(
+                "parameters are not locally addressable; cannot "
+                "survive a world change without a checkpoint restore"
+            ) from e
+        try:
+            self._opt_state = to_numpy(self._opt_state)
+        except Exception:  # noqa: BLE001 — lost ZeRO-1 shards
+            logger.warning(
+                "optimizer state not locally addressable (ZeRO-1 "
+                "shards lost with a dead peer); re-initializing "
+                "optimizer moments from params"
+            )
+            self._opt_state = self._spec.optimizer.init(self._params)
+
     def rebuild(self, mesh):
         """(Re)shard state and (re)compile steps for a (new) mesh.
 
