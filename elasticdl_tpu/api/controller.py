@@ -117,12 +117,12 @@ class ElasticCollectiveController:
             "world epoch %d: rank=%d world=%d",
             rdzv.rendezvous_id, rdzv.rank, rdzv.world_size,
         )
-        if self._first_init_done and hasattr(self._trainer,
-                                             "snapshot_to_host"):
-            # Re-forming a master-coordinated world clears XLA backends
-            # (parallel/distributed.py), which invalidates every device
-            # array of the old epoch — pull state to host FIRST, while
-            # the local backend is still alive.
+        if hasattr(self._trainer, "snapshot_to_host"):
+            # (Re-)forming a master-coordinated world clears XLA
+            # backends (parallel/distributed.py), which invalidates
+            # every device array of the old epoch — including the
+            # trainer's FIRST-init local-mesh state — so pull state to
+            # host while the local backend is still alive.
             self._trainer.snapshot_to_host()
         if self._mesh_builder is not None:
             # Multi-host path: the builder may call
@@ -155,6 +155,46 @@ class ElasticCollectiveController:
             self._first_init_done = True
             return True
         return False
+
+    @property
+    def world_size(self):
+        return self._rendezvous.world_size
+
+    def step_check(self):
+        """One training step's epoch check (driven mode — a managed
+        Worker calls this instead of wrapping its loop in
+        elastic_run): counts the step for the check_steps cadence and
+        re-forms the world when the cadence says to look."""
+        self._steps_since_check += 1
+        return self.init_world_if_needed()
+
+    def leave_world(self):
+        """Temporarily exit the collective world (idle worker, no task
+        in hand): snapshot state, drop the coordination client, restore
+        single-process mode.  Peers re-form without us; rejoin_world
+        re-enters.  Staying attached while idle would both stall every
+        peer's collectives AND get this process terminated when the
+        master reaps the epoch's service out from under its heartbeat
+        thread."""
+        from elasticdl_tpu.parallel.distributed import (
+            reset_single_process,
+        )
+
+        if hasattr(self._trainer, "snapshot_to_host"):
+            self._trainer.snapshot_to_host()
+        reset_single_process()
+
+    def rejoin_world(self, timeout=120.0):
+        """Re-enter the committed world after leave_world (the caller
+        re-announced itself via LOOP_START) and rebuild for it."""
+        self._rendezvous.poll(wait=True, timeout=timeout)
+        self._reinit_world()
+        # This WAS the world init: without this, the next step_check
+        # would re-run _reinit_world and spuriously disconnect from the
+        # live epoch service mid-epoch.
+        self._first_init_done = True
+        self._last_check = time.time()
+        self._steps_since_check = 0
 
     def await_new_epoch(self, timeout=60.0, poll_secs=0.5):
         """Block until the master commits a DIFFERENT epoch, then

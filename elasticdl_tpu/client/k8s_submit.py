@@ -85,6 +85,10 @@ def master_pod_manifest(master_argv, image, namespace="default",
         {"name": "POD_UID", "fieldRef": {"fieldPath": "metadata.uid"}},
         {"name": "POD_NAMESPACE",
          "fieldRef": {"fieldPath": "metadata.namespace"}},
+        # Pod IP: the per-epoch coordination services bind fresh ports
+        # that the master's Service does not map — workers dial the
+        # master POD directly for those (master/main.py coord_host).
+        {"name": "POD_IP", "fieldRef": {"fieldPath": "status.podIP"}},
     ]
     env = [
         e if "fieldRef" not in e else
@@ -93,7 +97,14 @@ def master_pod_manifest(master_argv, image, namespace="default",
     ]
     for name, value in (envs or {}).items():
         env.append({"name": name, "value": str(value)})
-    return {
+    # --volume in the job args mounts on the master pod too (the worker
+    # pods get the same mounts from K8sWorkerBackend) — reference
+    # k8s_volume.py semantics.
+    from elasticdl_tpu.client.k8s_renderer import parse_volume_string
+
+    volumes, mounts = parse_volume_string(
+        _argv_value(master_argv, "--volume", ""))
+    manifest = {
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {
@@ -121,6 +132,10 @@ def master_pod_manifest(master_argv, image, namespace="default",
             }],
         },
     }
+    if volumes:
+        manifest["spec"]["volumes"] = volumes
+        manifest["spec"]["containers"][0]["volumeMounts"] = mounts
+    return manifest
 
 
 def master_service_manifest(job_name, namespace="default",

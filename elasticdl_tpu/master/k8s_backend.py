@@ -103,7 +103,8 @@ class K8sWorkerBackend:
                  num_workers=0, high_priority_fraction=0.0,
                  priority_class_high="high-priority",
                  priority_class_low="", cluster_spec="",
-                 core_api=None, poll_secs=5.0, owner_ref=None):
+                 core_api=None, poll_secs=5.0, owner_ref=None,
+                 volume=""):
         # None = build the real client lazily on first API call, so the
         # master can construct the backend (flag parsing, manifests)
         # before cluster credentials are needed.
@@ -127,6 +128,11 @@ class K8sWorkerBackend:
         # pod/service, so deleting the master cascades the job (the
         # reference's ownership model, common/k8s_client.py:354-357).
         self._owner_ref = owner_ref
+        from elasticdl_tpu.client.k8s_renderer import parse_volume_string
+
+        # --volume mounts (reference k8s_volume.py semantics): applied
+        # to every worker pod this backend launches.
+        self._volumes, self._volume_mounts = parse_volume_string(volume)
         self._exit_events = {}  # pod name -> threading.Event w/ .code
 
     @property
@@ -205,6 +211,13 @@ class K8sWorkerBackend:
                 }],
             },
         }
+        if self._volumes:
+            manifest["spec"]["volumes"] = [
+                dict(v) for v in self._volumes
+            ]
+            manifest["spec"]["containers"][0]["volumeMounts"] = [
+                dict(m) for m in self._volume_mounts
+            ]
         if self._tpu_topology:
             manifest["spec"]["nodeSelector"] = {
                 "cloud.google.com/gke-tpu-topology": self._tpu_topology

@@ -4,6 +4,8 @@ Builds the control plane from flags, optionally launches/manages workers
 (local-process backend), runs the job to completion.
 """
 
+import os
+
 from elasticdl_tpu.data.factory import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.master import Master
@@ -27,7 +29,7 @@ _MASTER_ONLY_ARGS = (
     "max_task_retries", "task_timeout_secs", "relaunch_on_worker_failure",
     "grads_to_wait", "sync_version_tolerance",
     "worker_backend", "image", "namespace", "worker_resource_request",
-    "tpu_topology", "worker_pod_priority", "cluster_spec",
+    "tpu_topology", "worker_pod_priority", "cluster_spec", "volume",
 )
 
 
@@ -50,6 +52,7 @@ def _build_worker_backend(args, worker_args):
             high_priority_fraction=args.worker_pod_priority,
             cluster_spec=args.cluster_spec,
             owner_ref=owner_ref_from_env(),
+            volume=args.volume,
         )
     return ProcessWorkerBackend(worker_args=worker_args)
 
@@ -127,10 +130,29 @@ def build_master(args):
         # training task (reference: deferred train-end task,
         # task_manager.py:35-68 + callbacks.py:23-66).
         task_manager.set_train_end_callback_task()
-    rendezvous = (
-        RendezvousServer()
-        if args.distribution_strategy == "collective" else None
-    )
+    rendezvous = None
+    if args.distribution_strategy == "collective":
+        from elasticdl_tpu.parallel.distributed import (
+            MasterCoordinationService,
+        )
+
+        # The master hosts the per-epoch JAX coordination service so
+        # worker churn can never strand the survivors (see
+        # docs/designs/elastic_collectives.md).  Per-epoch services
+        # bind fresh ports the master's k8s Service does not map, so
+        # workers must dial the master POD itself: POD_IP (downward
+        # API, injected by the submission manifest) on k8s, localhost
+        # for process workers.
+        coord_host = (
+            os.environ.get("POD_IP")
+            or ("%s-master.%s.svc" % (args.job_name, args.namespace)
+                if args.worker_backend == "k8s" else "localhost")
+        )
+        rendezvous = RendezvousServer(
+            coordinator_factory=MasterCoordinationService(
+                host=coord_host
+            ).start_epoch,
+        )
     ps_manager = None
     if args.distribution_strategy == "ps" and args.num_ps > 0:
         from elasticdl_tpu.master.ps_manager import PSManager

@@ -234,6 +234,13 @@ def _reset_to_single_process():
     logger.info("collective world left: single-process mode restored")
 
 
+def reset_single_process():
+    """Public alias: leave any collective world and restore clean
+    single-process mode (used by idle workers stepping out of the
+    world while they wait for tasks)."""
+    _reset_to_single_process()
+
+
 def initialize_from_rendezvous(rank, world_size, coordinator_addr):
     """(Re-)initialize the collective runtime for a membership epoch.
 

@@ -82,6 +82,10 @@ def build_master_parser():
     parser.add_argument("--cluster_spec", default="",
                         help="dotted module with patch_pod/patch_service "
                              "hooks")
+    parser.add_argument("--volume", default="",
+                        help="pod volume mounts, reference syntax: "
+                             "'claim_name=c,mount_path=/p;"
+                             "host_path=/d,mount_path=/p2'")
     return parser
 
 

@@ -43,4 +43,23 @@ def unflatten_from_names(tree_like, named):
 
 
 def to_numpy(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+    """Pytree -> host numpy.
+
+    Handles multi-controller arrays: a REPLICATED global array carries
+    the whole value on every process (shard 0's data IS the array), so
+    it converts locally without any collective.  A genuinely sharded
+    non-addressable array has no local full value and raises."""
+
+    def _leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            shard = x.addressable_shards[0]
+            if shard.data.shape == x.shape:  # replicated
+                return np.asarray(shard.data)
+            raise ValueError(
+                "array of shape %s is sharded across processes; no "
+                "local full value (gather or checkpoint instead)"
+                % (x.shape,)
+            )
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(_leaf, tree)

@@ -149,6 +149,8 @@ class CollectiveTrainer(Trainer):
             self._replicated = None
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        self._local_eval_step = None  # rebuilt lazily: the old one may
+        # belong to a cleared backend (world change)
 
     def _opt_leaf_sharding(self, leaf):
         """ZeRO-1 placement for one optimizer-state leaf: shard dim 0
@@ -179,6 +181,31 @@ class CollectiveTrainer(Trainer):
     @property
     def global_device_count(self):
         return self._mesh.size if self._mesh is not None else 1
+
+    @property
+    def process_count(self):
+        """Number of processes the mesh spans (1 = single-controller)."""
+        if self._mesh is None:
+            return 1
+        return len({d.process_index for d in self._mesh.devices.flat})
+
+    def _globalize(self, tree, sharding):
+        """Assemble per-process local batches into global arrays.
+
+        Multi-controller SPMD: every process holds ITS share of the
+        global batch (its own task stream's records); the global array
+        is the concatenation over processes along the data axis.  The
+        single-process path hands numpy straight to jit (placement via
+        in_shardings) — identical math, no assembly step."""
+        if self.process_count == 1:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: jax.make_array_from_process_local_data(
+                sharding, np.asarray(a)
+            ),
+            tree,
+        )
+
 
     def set_accum_steps(self, accum_steps):
         if accum_steps != self._accum_steps:
@@ -318,16 +345,27 @@ class CollectiveTrainer(Trainer):
                 features,
             )
         with self.timing.timeit("batch_process"):
+            # Each process pads ITS local minibatch to its share of the
+            # global batch; _globalize assembles the global array in
+            # the multi-controller case (no-op single-process).
+            procs = self.process_count
             if self._accum_steps == 1:
-                total = self._batch_size * self.global_device_count
-                features, labels, weights = self._padded(
-                    features, labels, total
+                local = self._batch_size * (
+                    self.global_device_count // procs
                 )
-            else:
-                micro = self._batch_size * self.global_device_count
-                total = micro * self._accum_steps
                 features, labels, weights = self._padded(
-                    features, labels, total
+                    features, labels, local
+                )
+                features = self._globalize(features, self._batch_sharding)
+                labels = self._globalize(labels, self._batch_sharding)
+                weights = self._globalize(weights, self._batch_sharding)
+            else:
+                micro = self._batch_size * (
+                    self.global_device_count // procs
+                )
+                local = micro * self._accum_steps
+                features, labels, weights = self._padded(
+                    features, labels, local
                 )
                 reshape = lambda a: np.asarray(a).reshape(
                     (self._accum_steps, micro) + np.asarray(a).shape[1:]
@@ -335,6 +373,12 @@ class CollectiveTrainer(Trainer):
                 features = jax.tree_util.tree_map(reshape, features)
                 labels = jax.tree_util.tree_map(reshape, labels)
                 weights = weights.reshape(self._accum_steps, micro)
+                accum_sharding = NamedSharding(
+                    self._mesh, P(None, self._data_axis)
+                ) if self._mesh is not None else None
+                features = self._globalize(features, accum_sharding)
+                labels = self._globalize(labels, accum_sharding)
+                weights = self._globalize(weights, accum_sharding)
             self._params, self._opt_state, loss = self._train_step(
                 self._params, self._opt_state, features, labels, weights
             )
@@ -356,16 +400,45 @@ class CollectiveTrainer(Trainer):
         ):
             self.save_checkpoint()
 
+    def _forward_local(self, features):
+        """Inference on THIS process only: local device, local copy of
+        the replicated params.  Eval/predict tasks are handed to
+        individual workers by the task stream, so in a multi-controller
+        world they must never enter a collective — a lone worker doing
+        an eval task would deadlock every peer (the reference's
+        allreduce mode evaluates locally for the same reason).  The
+        host params copy is cached per model version (an eval task
+        runs many minibatches against unchanging params)."""
+        if getattr(self, "_local_eval_step", None) is None:
+            apply_fn = self._spec.apply_fn
+            self._local_eval_step = jax.jit(
+                lambda p, x: apply_fn(p, x, False)
+            )
+            self._local_params_cache = None
+        cache = getattr(self, "_local_params_cache", None)
+        if cache is None or cache[0] != self._version:
+            cache = (self._version, to_numpy(self._params))
+            self._local_params_cache = cache
+        return self._local_eval_step(cache[1], features)
+
     def evaluate_minibatch(self, features, labels):
         n = jax.tree_util.tree_leaves(features)[0].shape[0]
-        total = self._batch_size * self.global_device_count
-        features, _, _ = self._padded(features, labels, total)
-        outputs = self._eval_step(self._params, features)
+        if self.process_count > 1:
+            features, _, _ = self._padded(
+                features, labels, self._batch_size)
+            outputs = self._forward_local(features)
+        else:
+            total = self._batch_size * self.global_device_count
+            features, _, _ = self._padded(features, labels, total)
+            outputs = self._eval_step(self._params, features)
         outputs = np.asarray(outputs)[:n]
         return outputs, np.asarray(labels)
 
     def predict_minibatch(self, features):
         n = jax.tree_util.tree_leaves(features)[0].shape[0]
+        if self.process_count > 1:
+            padded, _ = _pad_batch(features, self._batch_size)
+            return np.asarray(self._forward_local(padded))[:n]
         total = self._batch_size * self.global_device_count
         leaves = jax.tree_util.tree_leaves(features)
         weights = None

@@ -13,6 +13,10 @@ from collections import deque
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
 
+# Sentinel: the master said "no task NOW, job not finished" (see
+# fetch_task(return_wait=True)).
+WAIT = object()
+
 
 class LocalTask:
     def __init__(self, task_pb):
@@ -37,17 +41,23 @@ class DataShardService:
     def stop(self):
         self._stopped.set()
 
-    def fetch_task(self, task_type=None, wait=True):
+    def fetch_task(self, task_type=None, wait=True, return_wait=False):
         """Fetch the next task; blocks through WAIT tasks if wait=True.
 
-        Returns None when the master says the job is finished.
+        Returns None when the master says the job is finished.  With
+        ``return_wait`` a WAIT answer returns the ``WAIT`` sentinel
+        instead of blocking — collective workers must not idle-spin
+        inside the world (worker/worker.py leave/rejoin protocol).
         """
         while not self._stopped.is_set():
             task_pb = self._mc.get_task(task_type)
             if task_pb.id < 0:
-                if task_pb.type == pb.WAIT and wait:
-                    time.sleep(self._wait_poll_secs)
-                    continue
+                if task_pb.type == pb.WAIT:
+                    if return_wait:
+                        return WAIT
+                    if wait:
+                        time.sleep(self._wait_poll_secs)
+                        continue
                 return None
             task = LocalTask(task_pb)
             if task.type == pb.TRAINING:

@@ -110,11 +110,40 @@ def build_worker(args):
     )
     if saver is not None:
         trainer.init_from_checkpoint()
+    elastic = None
+    if args.distribution_strategy == "collective":
+        # Managed elastic AllReduce: the controller consumes the
+        # master's rendezvous epochs from inside the task loop — the
+        # worker joins the (possibly multi-process) collective world,
+        # re-forms it on membership changes, and a finished/dead peer
+        # is just another epoch (docs/designs/elastic_collectives.md).
+        from elasticdl_tpu.api.controller import (
+            ElasticCollectiveController,
+        )
+        from elasticdl_tpu.parallel.distributed import (
+            initialize_from_rendezvous,
+        )
+
+        def mesh_builder(rank, world_size, coordinator_addr):
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            initialize_from_rendezvous(
+                rank, world_size, coordinator_addr)
+            return Mesh(np.array(jax.devices()), ("data",))
+
+        elastic = ElasticCollectiveController(
+            mc, trainer,
+            check_steps=max(1, args.num_minibatches_per_task),
+            mesh_builder=mesh_builder,
+        )
     worker = Worker(
         mc, reader, spec, trainer,
         batch_size=args.batch_size,
         log_loss_steps=args.log_loss_steps,
         join_rendezvous=args.distribution_strategy == "collective",
+        elastic_controller=elastic,
     )
     return worker
 

@@ -31,7 +31,14 @@ class Worker:
         max_minibatch_retries=DEFAULT_MAX_MINIBATCH_RETRY_NUM,
         log_loss_steps=100,
         join_rendezvous=False,
+        elastic_controller=None,
     ):
+        """``elastic_controller`` (ElasticCollectiveController): drives
+        the multi-controller collective world from inside the managed
+        task loop — epoch checks before minibatches (step-count
+        cadence, SPMD-aligned across workers) and await-new-epoch on a
+        failed collective.  None = single-process trainer (the
+        historical managed path)."""
         self._mc = master_client
         self._spec = spec
         self._trainer = trainer
@@ -39,6 +46,7 @@ class Worker:
         self._max_minibatch_retries = max_minibatch_retries
         self._log_loss_steps = log_loss_steps
         self._join_rendezvous = join_rendezvous
+        self._elastic = elastic_controller
         self._shard_service = DataShardService(master_client, batch_size)
         self._data_service = TaskDataService(data_reader, spec.feed)
         self.timing = Timing(logger=logger)
@@ -53,6 +61,12 @@ class Worker:
                 callback.on_train_batch_begin(self._trainer)
         for attempt in range(self._max_minibatch_retries):
             try:
+                if self._elastic is not None:
+                    # Step-count cadence: every member of the world
+                    # checks at the same collective index, so nobody
+                    # leaves an epoch while a peer is blocked inside
+                    # one of its collectives.
+                    self._elastic.step_check()
                 loss, version = self._trainer.train_minibatch(
                     features, labels
                 )
@@ -68,6 +82,24 @@ class Worker:
                 logger.warning(
                     "minibatch failed (attempt %d): %s", attempt + 1, e
                 )
+                if (
+                    self._elastic is not None
+                    and self._elastic.world_size > 1
+                ):
+                    # In-band collective failure: the world is dead
+                    # until the master commits a new epoch (reference
+                    # allreduce_trainer.py:77-91) — wait for it; if
+                    # none arrives (transient error, membership
+                    # unchanged) force a re-init of the current world.
+                    # Each of these costs up to a minute, so the
+                    # elastic path gets a SHORT retry budget — after
+                    # that the task fails and the task-retry machinery
+                    # takes over.
+                    if attempt + 1 >= 3:
+                        break
+                    if not self._elastic.await_new_epoch():
+                        self._elastic.init_world_if_needed(force=True)
+                    continue
                 # Exponential backoff so the retry budget rides out
                 # transient outages (a PS shard relaunching takes
                 # seconds; 64 instant retries would burn out in <1s).
@@ -145,12 +177,41 @@ class Worker:
 
     # -- main loop ----------------------------------------------------------
 
+    def _fetch_task_elastic(self):
+        """Fetch without idling INSIDE the collective world.
+
+        A worker holding no task must not stall its peers' collectives
+        (they step in lockstep) nor keep a heartbeat against an epoch
+        service the master will reap — so on WAIT it LEAVES the world
+        (LOOP_END + drop the coordination client; the survivors
+        re-form without it), polls for work from outside, and rejoins
+        (LOOP_START + re-init) when a task shows up."""
+        from elasticdl_tpu.worker.data_shard_service import WAIT
+
+        task = self._shard_service.fetch_task(return_wait=True)
+        if task is not WAIT:
+            return task
+        logger.info("no task available; leaving the collective world")
+        self._elastic.leave_world()
+        self._mc.report_train_loop_status(pb.LOOP_END)
+        while task is WAIT:
+            time.sleep(0.5)
+            task = self._shard_service.fetch_task(return_wait=True)
+        if task is not None:
+            logger.info("task available; rejoining the collective world")
+            self._mc.report_train_loop_status(pb.LOOP_START)
+            self._elastic.rejoin_world()
+        return task
+
     def run(self):
         if self._join_rendezvous:
             self._mc.report_train_loop_status(pb.LOOP_START)
         try:
             while True:
-                task = self._shard_service.fetch_task()
+                if self._elastic is not None:
+                    task = self._fetch_task_elastic()
+                else:
+                    task = self._shard_service.fetch_task()
                 if task is None:
                     break
                 if task.type == pb.TRAINING:

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Fire the full staged TPU measurement suite on a healthy relay window
+# (VERDICT r4 #1/#2/#3/#5).  Each stage is independently budgeted and
+# probe-gated, so a relay that wedges mid-window costs one stage, not
+# the rest.  Raw JSON lands in bench_results/ for BENCHMARKS.md.
+set -u
+cd /root/repo
+mkdir -p bench_results
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+
+echo "== stage 1: headline bench (bench.py) =="
+ELASTICDL_BENCH_TOTAL_BUDGET=${HEADLINE_BUDGET:-900} \
+  timeout 960 python bench.py \
+  > bench_results/headline_$ts.json 2> bench_results/headline_$ts.err
+tail -c 600 bench_results/headline_$ts.json; echo
+
+echo "== stage 2: kernel A/B matrix (bench_kernels.py) =="
+ELASTICDL_AB_TIMEOUT=${AB_TIMEOUT:-420} \
+  timeout 5400 python bench_kernels.py \
+  > bench_results/kernels_$ts.json 2> bench_results/kernels_$ts.err
+tail -c 600 bench_results/kernels_$ts.json; echo
+
+echo "== stage 3: TPU-inclusive elastic recovery (bench_elastic.py) =="
+ELASTICDL_ELASTIC_BENCH_BUDGET=${ELASTIC_BUDGET:-900} \
+  timeout 960 python bench_elastic.py \
+  > bench_results/elastic_$ts.json 2> bench_results/elastic_$ts.err
+tail -c 600 bench_results/elastic_$ts.json; echo
+
+echo "== window done: bench_results/*_$ts.json =="

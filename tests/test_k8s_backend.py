@@ -268,6 +268,42 @@ def test_pod_manifest_carries_extra_env_and_slot_addresses():
     assert env["WORKER_ID"] == "1"
 
 
+def test_volume_string_mounts_on_worker_pods():
+    """--volume (reference elasticdl_client/common/k8s_volume.py): PVC
+    and hostPath entries become pod volumes + container mounts; a
+    repeated claim reuses ONE volume with two mounts."""
+    from elasticdl_tpu.client.k8s_renderer import parse_volume_string
+
+    volumes, mounts = parse_volume_string(
+        "claim_name=data,mount_path=/data;"
+        "claim_name=data,mount_path=/data2,sub_path=sub,read_only=true;"
+        "host_path=/mnt/ssd,mount_path=/ssd"
+    )
+    assert [v["name"] for v in volumes] == [
+        "pvc-data-f363", "hostpath-mnt-ssd-4c86"]
+    assert volumes[0]["persistentVolumeClaim"]["claimName"] == "data"
+    assert volumes[1]["hostPath"]["path"] == "/mnt/ssd"
+    assert mounts[1] == {"name": "pvc-data-f363", "mountPath": "/data2",
+                         "subPath": "sub", "readOnly": True}
+    # Near-identical sources must NOT collapse to one volume name.
+    vols2, _ = parse_volume_string(
+        "claim_name=data.x,mount_path=/a;claim_name=data-x,mount_path=/b")
+    assert len({v["name"] for v in vols2}) == 2
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        parse_volume_string("claim_name=c")  # no mount_path
+    with _pytest.raises(ValueError):
+        parse_volume_string("mount_path=/p")  # no source
+
+    _, backend = make_backend(volume="claim_name=data,mount_path=/data")
+    pod = backend.pod_manifest(0, "m:1")
+    assert pod["spec"]["volumes"][0]["name"] == "pvc-data-f363"
+    assert (pod["spec"]["containers"][0]["volumeMounts"][0]["mountPath"]
+            == "/data")
+
+
 def test_worker_manager_drives_k8s_relaunch_end_to_end():
     """WorkerManager + K8sWorkerBackend against the fake API: preempt a
     pod (delete it), watch the DELETED -> relaunch flow create a fresh

@@ -163,9 +163,10 @@ class _ChurnBackend:
         env["ELASTICDL_TPU_PLATFORM"] = "cpu"
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         env["ELASTICDL_COLLECTIVE_HEARTBEAT"] = "5"
-        # Generous: a replacement needs ~10 s to boot + join, and BOTH
-        # survivors must still be training when the 3-world re-forms.
-        env["CHURN_SECS"] = "40"
+        # Generous: a replacement needs ~10 s to boot + join (double
+        # that on a loaded CI box), and BOTH survivors must still be
+        # training when the 3-world re-forms.
+        env["CHURN_SECS"] = "55"
         env["CHURN_KILL_SELF"] = str(self._kill_self_id)
         proc = subprocess.Popen(
             [sys.executable, "-c", _CHURN_PROG],

@@ -206,3 +206,39 @@ def test_evaluate_job_reports_metrics(tmp_path):
     text = ev.stdout + ev.stderr
     assert "job finished" in text
     assert "accuracy" in text, text[-2000:]
+
+
+@pytest.mark.slow
+def test_managed_collective_two_workers_form_world():
+    """Managed elastic AllReduce (SURVEY §2.12): a two-worker managed
+    job with --distribution_strategy collective forms a REAL
+    cross-process world through the master-hosted coordination plane —
+    both worker processes join one 2-device world, train global
+    batches in lockstep, survive the end-of-data membership change
+    (the first worker to drain the queue leaves; the other re-forms
+    and finishes), and the job completes with zero lost tasks."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTICDL_TPU_PLATFORM"] = "cpu"
+    env["ELASTICDL_COLLECTIVE_HEARTBEAT"] = "5"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "elasticdl_tpu.master.main",
+            "--model_zoo", "mnist", "--batch_size", "16",
+            "--num_workers", "2", "--num_minibatches_per_task", "4",
+            "--data_origin", "synthetic_mnist:1024",
+            "--distribution_strategy", "collective",
+        ],
+        capture_output=True, text=True, env=env, timeout=420,
+    )
+    text = proc.stdout + proc.stderr
+    assert proc.returncode == 0, text[-4000:]
+    assert "job finished" in text
+    assert "'failed': {0: 0" in text, text[-2000:]
+    # Both workers client-only joined the same 2-process world.
+    assert "collective world joined (client-only): rank 0 / 2" in text
+    assert "collective world joined (client-only): rank 1 / 2" in text
