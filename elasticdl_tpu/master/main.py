@@ -139,15 +139,24 @@ def build_master(args):
         # The master hosts the per-epoch JAX coordination service so
         # worker churn can never strand the survivors (see
         # docs/designs/elastic_collectives.md).  Per-epoch services
-        # bind fresh ports the master's k8s Service does not map, so
+        # bind fresh ports the master's k8s Service does NOT map, so
         # workers must dial the master POD itself: POD_IP (downward
         # API, injected by the submission manifest) on k8s, localhost
-        # for process workers.
-        coord_host = (
-            os.environ.get("POD_IP")
-            or ("%s-master.%s.svc" % (args.job_name, args.namespace)
-                if args.worker_backend == "k8s" else "localhost")
-        )
+        # for process workers.  Fail fast when it's missing — a
+        # Service-DNS fallback would only produce opaque worker-side
+        # connect timeouts.
+        if args.worker_backend == "k8s":
+            coord_host = os.environ.get("POD_IP")
+            if not coord_host:
+                raise RuntimeError(
+                    "collective strategy on k8s requires the POD_IP "
+                    "downward-API env (the per-epoch coordination "
+                    "ports are not mapped by the master Service); "
+                    "resubmit with a current client — "
+                    "client/k8s_submit.py injects it"
+                )
+        else:
+            coord_host = "localhost"
         rendezvous = RendezvousServer(
             coordinator_factory=MasterCoordinationService(
                 host=coord_host

@@ -154,7 +154,10 @@ class WorkerManager:
         if code == 0:
             event = ws.EV_EXIT_0
             handle.status = ws.RUNNING  # exit implies it ran
-        elif was_preempted or code in (-signal.SIGTERM, -signal.SIGKILL):
+        elif was_preempted or code in (-signal.SIGTERM, -signal.SIGKILL,
+                                       143):
+            # 143 = the worker's graceful-preemption exit (it caught
+            # SIGTERM, checkpointed, and asked to be relaunched).
             # A raw SIGKILL is ambiguous for local processes: kernel OOM
             # kills and external preemption both yield -9.  We classify it
             # as preemption (the common case on preemptible TPU hosts);

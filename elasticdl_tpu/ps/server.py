@@ -115,7 +115,17 @@ class ParameterServer:
         self._done.wait()
         self.stop()
 
-    def stop(self):
+    def stop(self, checkpoint=False):
+        if checkpoint:
+            # Graceful preemption (SIGTERM): persist the shard's
+            # CURRENT state — params, embedding tables, optimizer
+            # slots — so the relaunched shard resumes this exact
+            # version instead of the last periodic save.
+            try:
+                self.servicer.checkpoint_now()
+            except Exception as e:  # noqa: BLE001 — best effort under
+                # a kill deadline
+                logger.error("preemption checkpoint failed: %s", e)
         self._done.set()
         if self._server is not None:
             self._server.stop(grace=1)
@@ -132,7 +142,7 @@ def main(argv=None):
         master_client = MasterClient(channel, worker_id=-1)
     ps = ParameterServer(args, master_client=master_client)
     ps.prepare()
-    signal.signal(signal.SIGTERM, lambda *a: ps.stop())
+    signal.signal(signal.SIGTERM, lambda *a: ps.stop(checkpoint=True))
     ps.run()
     return 0
 

@@ -245,6 +245,39 @@ class PserverServicer:
             self._params, dense, emb, lr_multiplier=lr_mult
         )
 
+    def checkpoint_now(self):
+        """Write this shard's checkpoint at the CURRENT version, under
+        the update lock — the SIGTERM path (ps/server.py
+        stop(checkpoint=True)) can land while a push_gradients apply is
+        mid-flight, and a torn params/slots snapshot would restore a
+        state that never existed."""
+        with self._lock:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self):
+        """Body of checkpoint_now; caller holds self._lock (the
+        periodic path _post_update already runs under it — the lock is
+        not reentrant)."""
+        if self._checkpoint_saver is None:
+            return
+        v = self._params.version
+        try:
+            dense, embeddings = self._params.to_checkpoint_payload()
+            # Dense optimizer slot state rides along under an
+            # "optslot/" prefix so a restored shard resumes
+            # Adam/Momentum trajectories (the embedding slot tables
+            # are already in the payload).
+            for key, arr in self._opt.slots_to_payload().items():
+                dense["optslot/" + key] = arr
+            self._checkpoint_saver.save_shard(
+                v, self._ps_id, self._num_ps,
+                dense=dense, embeddings=embeddings,
+            )
+        except OSError as e:
+            # Sibling shards GC concurrently; a lost checkpoint must
+            # never fail the worker's push RPC.
+            logger.warning("checkpoint at v%d failed: %s", v, e)
+
     def _post_update(self):
         v = self._params.version
         if (
@@ -252,22 +285,7 @@ class PserverServicer:
             and self._checkpoint_steps
             and v % self._checkpoint_steps == 0
         ):
-            try:
-                dense, embeddings = self._params.to_checkpoint_payload()
-                # Dense optimizer slot state rides along under an
-                # "optslot/" prefix so a restored shard resumes
-                # Adam/Momentum trajectories (the embedding slot tables
-                # are already in the payload).
-                for key, arr in self._opt.slots_to_payload().items():
-                    dense["optslot/" + key] = arr
-                self._checkpoint_saver.save_shard(
-                    v, self._ps_id, self._num_ps,
-                    dense=dense, embeddings=embeddings,
-                )
-            except OSError as e:
-                # Sibling shards GC concurrently; a lost checkpoint must
-                # never fail the worker's push RPC.
-                logger.warning("checkpoint at v%d failed: %s", v, e)
+            self._checkpoint_locked()
         if (
             self._master_client is not None
             and self._evaluation_steps

@@ -28,7 +28,8 @@ class LocalTask:
 
 
 class DataShardService:
-    def __init__(self, master_client, batch_size=1, wait_poll_secs=0.5):
+    def __init__(self, master_client, batch_size=1, wait_poll_secs=0.5,
+                 stop_check=None):
         self._mc = master_client
         self._batch_size = batch_size
         self._wait_poll_secs = wait_poll_secs
@@ -36,6 +37,7 @@ class DataShardService:
         self._pending = deque()   # tasks whose records are being consumed
         self._record_count = 0
         self._stopped = threading.Event()
+        self._stop_check = stop_check  # e.g. graceful-preemption flag
         self.exec_counters = {"batch_count": 0, "record_count": 0}
 
     def stop(self):
@@ -55,7 +57,9 @@ class DataShardService:
                 if task_pb.type == pb.WAIT:
                     if return_wait:
                         return WAIT
-                    if wait:
+                    if wait and not (
+                        self._stop_check and self._stop_check()
+                    ):
                         time.sleep(self._wait_poll_secs)
                         continue
                 return None
@@ -82,7 +86,11 @@ class DataShardService:
                     task.id, exec_counters=self.exec_counters
                 )
 
-    def report_task_failed(self, task, err_message):
+    def report_task_failed(self, task, err_message, requeue=False):
+        """``requeue``: hand the task back WITHOUT consuming one of its
+        retries (graceful preemption — the task isn't at fault; on a
+        preemptible pool the same task could otherwise burn its whole
+        retry budget on evictions and permanently fail)."""
         with self._lock:
             try:
                 was_head = self._pending and self._pending[0] is task
@@ -97,7 +105,8 @@ class DataShardService:
                     )
             except ValueError:
                 pass
-        self._mc.report_task_result(task.id, err_message=err_message)
+        self._mc.report_task_result(task.id, err_message=err_message,
+                                    requeue=requeue)
 
     def report_task_done(self, task):
         with self._lock:

@@ -149,9 +149,25 @@ def build_worker(args):
 
 
 def main(argv=None):
+    import signal
+
+    from elasticdl_tpu.worker.worker import PREEMPTED_EXIT_CODE
+
     args = parse_worker_args(argv)
     logger.info("worker starting: %s", vars(args))
     worker = build_worker(args)
+
+    def _graceful_preempt(_sig, _frame):
+        # Preemptible hosts deliver SIGTERM with a grace window: finish
+        # the in-flight minibatch, checkpoint, exit 143 (the manager
+        # relaunches a replacement).
+        logger.warning("SIGTERM received: graceful preemption")
+        worker.request_stop()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful_preempt)
+    except ValueError:
+        pass  # not the main thread (embedded use)
     if args.profile_dir:
         from elasticdl_tpu.utils.timing import device_trace
 
@@ -159,6 +175,9 @@ def main(argv=None):
             worker.run()
     else:
         worker.run()
+    if worker.preempted:
+        logger.info("worker preempted (checkpointed)")
+        return PREEMPTED_EXIT_CODE
     logger.info("worker done")
     return 0
 

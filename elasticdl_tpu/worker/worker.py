@@ -19,6 +19,15 @@ logger = get_logger(__name__)
 
 DEFAULT_MAX_MINIBATCH_RETRY_NUM = 64
 
+# Container convention for "terminated by SIGTERM" — the worker manager
+# classifies it as a preemption (relaunch), not a failure.
+PREEMPTED_EXIT_CODE = 143
+
+
+class PreemptedExit(Exception):
+    """Raised inside the task loop when a graceful-preemption stop was
+    requested (SIGTERM): unwind cleanly after the current minibatch."""
+
 
 class Worker:
     def __init__(
@@ -47,10 +56,27 @@ class Worker:
         self._log_loss_steps = log_loss_steps
         self._join_rendezvous = join_rendezvous
         self._elastic = elastic_controller
-        self._shard_service = DataShardService(master_client, batch_size)
+        self._shard_service = DataShardService(
+            master_client, batch_size,
+            # The WAIT poll must abort on graceful preemption — an idle
+            # worker's grace window would otherwise expire inside it.
+            stop_check=lambda: self._preempt_requested,
+        )
         self._data_service = TaskDataService(data_reader, spec.feed)
         self.timing = Timing(logger=logger)
         self._steps = 0
+        self._preempt_requested = False
+        self.preempted = False
+
+    def request_stop(self):
+        """Graceful-preemption hook (SIGTERM handler, worker main):
+        finish the in-flight minibatch, checkpoint if configured,
+        report the unfinished task back, exit with PREEMPTED_EXIT_CODE
+        so the manager relaunches.  Preemptible TPU VMs give ~30 s of
+        notice — enough to save the optimizer trajectory instead of
+        replaying from the last periodic checkpoint (reference analog:
+        pod eviction grace)."""
+        self._preempt_requested = True
 
     # -- task handlers ------------------------------------------------------
 
@@ -125,6 +151,16 @@ class Worker:
                 ):
                     self._process_minibatch(features, labels)
                     self._shard_service.report_batch_done(count)
+                    if self._preempt_requested:
+                        raise PreemptedExit()
+            except PreemptedExit:
+                # Give the unfinished remainder back WITHOUT consuming
+                # a retry (the task isn't at fault — frequent evictions
+                # must not permanently fail it), and unwind to run(),
+                # which checkpoints and exits.
+                self._shard_service.report_task_failed(
+                    task, "worker preempted (graceful)", requeue=True)
+                raise
             except Exception as e:  # noqa: BLE001
                 # Report the failure so the master can retry the task on
                 # another worker; keep this worker alive for the next task.
@@ -195,6 +231,8 @@ class Worker:
         self._elastic.leave_world()
         self._mc.report_train_loop_status(pb.LOOP_END)
         while task is WAIT:
+            if self._preempt_requested:
+                raise PreemptedExit()  # honor SIGTERM while idle too
             time.sleep(0.5)
             task = self._shard_service.fetch_task(return_wait=True)
         if task is not None:
@@ -208,11 +246,17 @@ class Worker:
             self._mc.report_train_loop_status(pb.LOOP_START)
         try:
             while True:
+                if self._preempt_requested:
+                    raise PreemptedExit()
                 if self._elastic is not None:
                     task = self._fetch_task_elastic()
                 else:
                     task = self._shard_service.fetch_task()
                 if task is None:
+                    if self._preempt_requested:
+                        # The fetch aborted because of the SIGTERM, not
+                        # because the job finished — checkpoint first.
+                        raise PreemptedExit()
                     break
                 if task.type == pb.TRAINING:
                     self._train_task(task)
@@ -225,6 +269,18 @@ class Worker:
                 else:
                     logger.warning("unknown task type %s", task.type)
                     self._shard_service.report_task_done(task)
+        except PreemptedExit:
+            self.preempted = True
+            logger.warning(
+                "graceful preemption: saving checkpoint and exiting")
+            if getattr(self._trainer, "_checkpoint_saver", None):
+                try:
+                    self._trainer.save_checkpoint()
+                    self._trainer.flush_checkpoints()
+                except Exception as e:  # noqa: BLE001 — best effort
+                    # under a kill deadline: a failed save must not
+                    # mask the preemption exit path
+                    logger.error("preemption checkpoint failed: %s", e)
         finally:
             if self._join_rendezvous:
                 self._mc.report_train_loop_status(pb.LOOP_END)

@@ -29,7 +29,8 @@ class FakeMasterClient:
     def report_batch_done(self, count):
         pass
 
-    def report_task_result(self, task_id, err_message="", exec_counters=None):
+    def report_task_result(self, task_id, err_message="",
+                           exec_counters=None, requeue=False):
         self.results.append((task_id, err_message))
 
 

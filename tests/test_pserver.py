@@ -1,6 +1,8 @@
 """PS servicer semantics over real in-process gRPC (reference pattern:
 pserver_servicer_test.py:107-533, go server_test.go:85-265)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -163,6 +165,31 @@ def test_embedding_pull_and_sparse_update():
         np.testing.assert_allclose(rows[1], -0.1 * 1.0)
     finally:
         stop_all(servers)
+
+
+def test_graceful_preemption_checkpoint_now(tmp_path):
+    """checkpoint_now (the SIGTERM path, ps/server.py stop(
+    checkpoint=True)) persists the CURRENT version even with periodic
+    checkpointing disabled — the only save a preempted shard gets."""
+    saver_dir = str(tmp_path)
+    client, servicers, servers = start_ps(
+        num_ps=1, use_async=True,
+        checkpoint_saver=CheckpointSaver(saver_dir), checkpoint_steps=0,
+    )
+    try:
+        client.push_model({"w": np.ones(3, np.float32)})
+        client.push_gradients({"w": np.ones(3, np.float32)}, {},
+                              version=0)
+        assert not any(
+            name.startswith("version-")
+            for name in os.listdir(saver_dir)
+        )  # periodic saves off
+        servicers[0].checkpoint_now()
+    finally:
+        stop_all(servers)
+    dense, _, version = CheckpointSaver(saver_dir).load_shard(None, 0, 1)
+    assert version == 1
+    np.testing.assert_allclose(dense["w"], 1 - 0.1)
 
 
 def test_checkpoint_and_restore_roundtrip(tmp_path):

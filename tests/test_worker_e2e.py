@@ -242,3 +242,69 @@ def test_managed_collective_two_workers_form_world():
     # Both workers client-only joined the same 2-process world.
     assert "collective world joined (client-only): rank 0 / 2" in text
     assert "collective world joined (client-only): rank 1 / 2" in text
+
+
+@pytest.mark.slow
+def test_graceful_preemption_checkpoints_before_exit(tmp_path):
+    """SIGTERM mid-run (the preemptible-VM grace signal): the worker
+    finishes its minibatch, saves a checkpoint (checkpoint_steps=0 —
+    no periodic saves, so any checkpoint on disk came from the
+    graceful path), exits 143, the manager classifies it as a
+    preemption and relaunches, and the job finishes with zero lost
+    tasks."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTICDL_TPU_PLATFORM"] = "cpu"
+    ckpt = str(tmp_path / "ckpt")
+    job = "graceful-preempt-drill"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.master.main",
+            "--job_name", job,
+            "--model_zoo", "mnist", "--batch_size", "32",
+            "--num_workers", "1", "--num_minibatches_per_task", "4",
+            "--data_origin", "synthetic_mnist:4096", "--num_epochs", "2",
+            "--checkpoint_dir", ckpt, "--checkpoint_steps", "0",
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.time() + 120
+        wpid = None
+        while time.time() < deadline and wpid is None:
+            out = subprocess.run(
+                ["pgrep", "-f",
+                 "elasticdl_tpu.worker.main.*%s" % job],
+                capture_output=True, text=True,
+            )
+            pids = [int(p) for p in out.stdout.split()]
+            if pids:
+                wpid = pids[0]
+            else:
+                time.sleep(0.5)
+        assert wpid, "worker never appeared"
+        time.sleep(20)  # let it get into training
+        os.kill(wpid, _signal.SIGTERM)
+        out, _ = proc.communicate(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, out[-4000:]
+    assert "job finished" in out
+    assert "'failed': {0: 0" in out, out[-2000:]
+    assert "graceful preemption: saving checkpoint" in out
+    # exit 143 classified as preemption -> relaunch, not failure
+    assert "exited code=143 event=preempted" in out, out[-3000:]
+    # With checkpoint_steps=0 the ONLY possible checkpoint is the
+    # graceful-preemption one.
+    assert os.path.isdir(ckpt) and any(
+        name.startswith("version-") for name in os.listdir(ckpt)
+    ), os.listdir(ckpt) if os.path.isdir(ckpt) else "no ckpt dir"
