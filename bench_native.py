@@ -21,9 +21,6 @@ import time
 
 import numpy as np
 
-os.environ.setdefault("ELASTICDL_TPU_PLATFORM", "cpu")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 
 def _median_secs(fn, repeats=5):
     times = []

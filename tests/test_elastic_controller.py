@@ -100,3 +100,146 @@ def test_controller_retries_on_step_failure():
             always_fails()
     finally:
         master.stop()
+
+
+def test_step_check_cadence_is_step_counted():
+    """check_steps=N: the rendezvous is polled every N wrapped calls —
+    the SPMD-safe cadence (all members observe a new epoch at the same
+    collective index), not wall-clock."""
+    master = create_master(
+        training_shards=[("f", 0, 8)], records_per_task=8,
+        rendezvous=True,
+    )
+    try:
+        mc = create_master_client(master, worker_id=0)
+        trainer = FakeTrainer()
+        controller = ElasticCollectiveController(
+            mc, trainer, check_steps=3,
+            mesh_builder=lambda r, w, c: ("mesh", w),
+        )
+        with controller.scope():
+            import time
+            time.sleep(0.15)
+            controller.step_check()  # first call: world init
+            assert trainer.rebuilds == [("mesh", 1)]
+            # second worker joins; cadence says: no check for 2 calls
+            mc2 = create_master_client(master, worker_id=1)
+            mc2.report_train_loop_status(pb.LOOP_START)
+            time.sleep(0.15)
+            controller.step_check()
+            controller.step_check()
+            assert trainer.rebuilds == [("mesh", 1)]  # not yet
+            controller.step_check()  # 3rd call since check -> poll
+            assert trainer.rebuilds[-1] == ("mesh", 2)
+    finally:
+        master.stop()
+
+
+def test_await_new_epoch_times_out_without_change():
+    master = create_master(
+        training_shards=[("f", 0, 8)], records_per_task=8,
+        rendezvous=True,
+    )
+    try:
+        mc = create_master_client(master, worker_id=0)
+        controller = ElasticCollectiveController(
+            mc, FakeTrainer(), check_secs=0.0)
+        with controller.scope():
+            import time
+            time.sleep(0.15)
+            controller.init_world_if_needed()
+            t0 = time.monotonic()
+            assert controller.await_new_epoch(timeout=0.5,
+                                              poll_secs=0.05) is False
+            assert time.monotonic() - t0 < 5.0
+    finally:
+        master.stop()
+
+
+def test_leave_and_rejoin_world():
+    """The idle-worker protocol: leave_world snapshots + exits, the
+    master commits a smaller epoch; rejoin_world re-enters after
+    LOOP_START and rebuilds — and the next step_check does NOT
+    redundantly re-init (rejoin counts as the world init)."""
+    master = create_master(
+        training_shards=[("f", 0, 8)], records_per_task=8,
+        rendezvous=True,
+    )
+    try:
+        mc = create_master_client(master, worker_id=0)
+
+        class SnapshotTrainer(FakeTrainer):
+            def __init__(self):
+                super().__init__()
+                self.snapshots = 0
+
+            def snapshot_to_host(self):
+                self.snapshots += 1
+
+        trainer = SnapshotTrainer()
+        controller = ElasticCollectiveController(
+            mc, trainer, check_steps=1,
+            mesh_builder=lambda r, w, c: ("mesh", w),
+        )
+        import time
+
+        with controller.scope():
+            time.sleep(0.15)
+            controller.step_check()
+            assert trainer.rebuilds == [("mesh", 1)]
+            controller.leave_world()
+            assert trainer.snapshots >= 1
+            mc.report_train_loop_status(pb.LOOP_END)
+            time.sleep(0.15)
+            # commits are lazy (inside get_comm_rank) — poke one
+            rank, size, _, _ = master.rendezvous_server.get_comm_rank(
+                "worker-0")
+            assert (rank, size) == (-1, 0)
+            mc.report_train_loop_status(pb.LOOP_START)
+            controller.rejoin_world(timeout=10)
+            assert trainer.rebuilds[-1] == ("mesh", 1)
+            rebuilds_after_rejoin = len(trainer.rebuilds)
+            controller.step_check()  # must NOT re-init the same epoch
+            assert len(trainer.rebuilds) == rebuilds_after_rejoin
+    finally:
+        master.stop()
+
+
+def test_zero1_snapshot_falls_back_to_fresh_moments(monkeypatch):
+    """snapshot_to_host: params must survive a world change; ZeRO-1
+    optimizer shards lost with a dead peer are re-initialized from
+    params (the information loss a Horovod restart accepts when it
+    reloads a checkpoint without slots)."""
+    import numpy as np
+
+    from elasticdl_tpu.models import mnist
+    from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+    spec = mnist.model_spec()
+    trainer = CollectiveTrainer(spec, batch_size=4)
+    xs, ys = mnist.synthetic_data(n=4)
+    trainer.train_minibatch(xs, ys)  # moments become non-zero
+
+    from elasticdl_tpu.utils.pytree import to_numpy as real_to_numpy
+
+    calls = {"n": 0}
+
+    def flaky_to_numpy(tree):
+        calls["n"] += 1
+        if calls["n"] == 2:  # params succeed; opt state "sharded away"
+            raise ValueError("array is sharded across processes")
+        return real_to_numpy(tree)
+
+    monkeypatch.setattr(
+        "elasticdl_tpu.worker.collective_trainer.to_numpy",
+        flaky_to_numpy,
+    )
+    trainer.snapshot_to_host()
+    # params preserved; moments re-initialized (zeros)
+    import jax
+
+    opt_leaves = jax.tree_util.tree_leaves(trainer._opt_state)
+    big = [leaf for leaf in opt_leaves if np.size(leaf) > 1]
+    assert big and all(
+        np.allclose(np.asarray(leaf), 0) for leaf in big
+    )
