@@ -15,7 +15,6 @@ faults don't land in the measured region.
 """
 
 import json
-import os
 import sys
 import time
 

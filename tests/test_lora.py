@@ -1,0 +1,135 @@
+"""LoRA fine-tuning of the flagship LM (models/lora.py).
+
+Contracts: zero-delta init reproduces the base model exactly; training
+moves ONLY the adapters (frozen base is bitwise unchanged); merging
+folds the adaptation into vanilla transformer params that forward /
+generate / export consume with no LoRA code; the pretrain -> export ->
+adapt-from-export story round-trips.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.models import lora, transformer as tfm
+from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
+from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+LM_KW = dict(vocab_size=128, dim=32, num_heads=4, num_layers=2,
+             seq_len=16, dtype="float32")
+
+
+def make_tokens(b, t, seed):
+    return np.random.RandomState(seed).randint(
+        0, 128, size=(b, t)).astype(np.int32)
+
+
+def test_zero_delta_init_matches_base():
+    spec = lora.model_spec(rank=4, **LM_KW)
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    toks = make_tokens(2, 8, seed=1)
+    got = np.asarray(spec.apply_fn(params, toks, False))
+    want = np.asarray(
+        tfm.forward(params["base"], toks, spec.config))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_training_moves_only_adapters():
+    spec = lora.model_spec(rank=4, **LM_KW)
+    trainer = CollectiveTrainer(spec, batch_size=4)
+    before = to_numpy(trainer._params)
+    toks = make_tokens(4, 16, seed=2)
+    losses = [trainer.train_minibatch(toks, toks)[0] for _ in range(8)]
+    after = to_numpy(trainer._params)
+
+    base_b, _ = flatten_with_names(before["base"])
+    base_a, _ = flatten_with_names(after["base"])
+    for name in base_b:
+        np.testing.assert_array_equal(
+            base_b[name], base_a[name],
+            err_msg="frozen base param %s moved" % name)
+
+    moved = [
+        t for t, ab in after["lora"].items()
+        if np.abs(ab["B"]).max() > 0
+    ]
+    assert sorted(moved) == sorted(lora.DEFAULT_TARGETS), moved
+    assert losses[-1] < losses[0], losses  # it actually learns
+
+
+def test_merged_params_fold_exactly():
+    spec = lora.model_spec(rank=4, alpha=8, **LM_KW)
+    trainer = CollectiveTrainer(spec, batch_size=4)
+    toks = make_tokens(4, 16, seed=3)
+    for _ in range(3):
+        trainer.train_minibatch(toks, toks)
+    params = to_numpy(trainer._params)
+    merged = lora.merged_params(params, scaling=spec.lora["scaling"])
+    probe = make_tokens(2, 8, seed=4)
+    want = np.asarray(spec.apply_fn(params, probe, False))
+    got = np.asarray(tfm.forward(merged, probe, spec.config))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # merged params drive the vanilla KV-cache decode path
+    out = np.asarray(tfm.generate(merged, spec.config, probe,
+                                  max_new_tokens=3))
+    assert out.shape == (2, 11)
+
+
+def test_adapt_from_base_export(tmp_path):
+    """Pretrain -> export -> LoRA spec loads the exported base."""
+    base_spec = tfm.model_spec(**LM_KW)
+    trainer = CollectiveTrainer(base_spec, batch_size=4)
+    toks = make_tokens(4, 16, seed=5)
+    trainer.train_minibatch(toks, toks)
+
+    from elasticdl_tpu.models.callbacks import ModelExporter
+
+    export_dir = str(tmp_path / "base")
+    ModelExporter(export_dir, model_name="lm").on_train_end(trainer)
+
+    spec = lora.model_spec(rank=4, base_export=export_dir, **LM_KW)
+    params = spec.init_fn(jax.random.PRNGKey(7))
+    want, _ = flatten_with_names(to_numpy(trainer._params))
+    got, _ = flatten_with_names(to_numpy(params["base"]))
+    for name in want:
+        np.testing.assert_allclose(
+            got[name], want[name], rtol=1e-6, atol=1e-6,
+            err_msg="base weight %s not loaded from export" % name)
+
+
+def test_mlp_targets_and_gqa_window_variant():
+    """Adapters on MLP matrices too, under a GQA + sliding-window
+    config — merge-at-forward must compose with every variant."""
+    spec = lora.model_spec(
+        rank=2, lora_targets="wq,wo,w_gate,w_up,w_down",
+        num_kv_heads=2, window=4, **LM_KW)
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    assert sorted(params["lora"]) == [
+        "w_down", "w_gate", "w_up", "wo", "wq"]
+    toks = make_tokens(2, 16, seed=6)
+    out = np.asarray(spec.apply_fn(params, toks, False))
+    assert out.shape == (2, 16, 128)
+    want = np.asarray(tfm.forward(params["base"], toks, spec.config))
+    np.testing.assert_allclose(out, want, rtol=1e-6, atol=1e-6)
+
+
+def test_train_norms_variant_moves_norms_without_decay():
+    """train_norms=True: norm scales train (no weight decay — decay
+    would pull the 1.0-initialized RMSNorm scales toward zero), the
+    rest of the base stays frozen."""
+    spec = lora.model_spec(rank=2, train_norms=True, **LM_KW)
+    trainer = CollectiveTrainer(spec, batch_size=4)
+    before = to_numpy(trainer._params)
+    toks = make_tokens(4, 16, seed=8)
+    for _ in range(4):
+        trainer.train_minibatch(toks, toks)
+    after = to_numpy(trainer._params)
+    assert not np.array_equal(before["base"]["ln_f"],
+                              after["base"]["ln_f"])
+    assert not np.array_equal(before["base"]["layers"]["ln1"],
+                              after["base"]["layers"]["ln1"])
+    np.testing.assert_array_equal(before["base"]["embed"],
+                                  after["base"]["embed"])
+    np.testing.assert_array_equal(before["base"]["layers"]["wq"],
+                                  after["base"]["layers"]["wq"])
