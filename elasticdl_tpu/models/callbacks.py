@@ -21,16 +21,18 @@ logger = get_logger(__name__)
 
 class ModelExporter:
     def __init__(self, export_dir, checkpoint_dir=None, model_name="",
-                 versioned=False):
+                 versioned=False, quantize=None):
         """With ``versioned`` the export lands in
         ``export_dir/<trainer.version>/`` (the TF-Serving layout), so a
         live ``serving.server`` pointed at ``export_dir`` hot-swaps to
         it; otherwise ``export_dir`` itself is the export (flat, the
-        historical layout)."""
+        historical layout).  ``quantize="int8"``: weights-only int8
+        servable (serving/export.py)."""
         self.export_dir = export_dir
         self.checkpoint_dir = checkpoint_dir
         self.model_name = model_name
         self.versioned = versioned
+        self.quantize = quantize
 
     def _merged_embeddings(self):
         """({table: (ids, values)}, dense, version) from the latest PS
@@ -85,9 +87,15 @@ class ModelExporter:
                 version=getattr(trainer, "version", 0),
                 embeddings=embeddings,
                 dense_overrides=ckpt_dense,
+                quantize=self.quantize,
             )
             return
         # Fallback (no bundle): weights-only v1 export.
+        if self.quantize:
+            logger.warning(
+                "quantize=%r ignored: the v1 weights-only fallback "
+                "export does not quantize (no serving bundle from "
+                "this trainer)", self.quantize)
         os.makedirs(export_dir, exist_ok=True)
         payload = dict(trainer.export_parameters())
         payload.update(ckpt_dense)
@@ -113,7 +121,10 @@ class ModelExporter:
 
 
 def load_export(export_dir):
-    """Load an export back into ({name: array}, {table: (ids, values)})."""
+    """Load an export back into ({name: array}, {table: (ids, values)});
+    int8-quantized weights (``q8/`` keys) dequantize transparently, so
+    a quantized export works everywhere a full one does (e.g. as a
+    LoRA ``base_export``)."""
     dense = {}
     embeddings = {}
     with np.load(os.path.join(export_dir, "model.npz")) as z:
@@ -121,7 +132,11 @@ def load_export(export_dir):
             if key.startswith("emb_ids/"):
                 name = key[len("emb_ids/"):]
                 embeddings[name] = (z[key], z["emb_vals/" + name])
-            elif not key.startswith("emb_vals/"):
+            elif key.startswith("q8/"):
+                name = key[len("q8/"):]
+                dense[name] = (z[key].astype(np.float32)
+                               * z["q8scale/" + name])
+            elif not key.startswith(("emb_vals/", "q8scale/")):
                 dense[key] = z[key]
     return dense, embeddings
 

@@ -50,10 +50,44 @@ def _signature(tree):
     )
 
 
+def _quantize_int8(flat, min_elems=4096):
+    """Weights-only per-channel symmetric int8 for large float arrays.
+
+    Returns ({name: payload_arrays}, [quantized names]).  Each
+    quantized W becomes ``q8/<name>`` (int8) + ``q8scale/<name>``
+    (float32 per-last-axis-channel scales); small arrays and non-float
+    arrays ride through unchanged.  The STABLEHLO program still takes
+    f32 — the loader dequantizes at load time, so this trades a tiny
+    load-time cost and ~0.4% weight rounding error for a ~4x smaller
+    artifact (the win is distribution/storage, not compute).
+    """
+    payload = {}
+    quantized = []
+    for name, arr in flat.items():
+        arr = np.asarray(arr)
+        # float32 ONLY: the StableHLO program was traced with the
+        # original dtypes and the loader dequantizes to f32 — an
+        # f16/f64 param would come back with the wrong dtype and fail
+        # every predict (bf16 rides through anyway: not a numpy
+        # floating subtype).
+        if arr.ndim < 2 or arr.size < min_elems or (
+            arr.dtype != np.float32
+        ):
+            payload[name] = arr
+            continue
+        scale = np.abs(arr).max(axis=-1, keepdims=True) / 127.0
+        scale = np.maximum(scale, 1e-12).astype(np.float32)
+        q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+        payload["q8/" + name] = q
+        payload["q8scale/" + name] = scale
+        quantized.append(name)
+    return payload, quantized
+
+
 def export_servable(export_dir, apply_fn, params, example_input,
                     model_name="", version=0, embeddings=None,
                     dense_overrides=None, platforms=("cpu", "tpu"),
-                    polymorphic_batch=True):
+                    polymorphic_batch=True, quantize=None):
     """Write a standalone servable export.
 
     apply_fn: (params_pytree, inputs) -> outputs (inference mode —
@@ -68,6 +102,10 @@ def export_servable(export_dir, apply_fn, params, example_input,
     a server can't fix its clients' batch at training time.  Falls back
     to the example's fixed shapes if symbolic export fails (e.g. a
     model whose lowering needs concrete dims).
+
+    ``quantize="int8"``: weights-only per-channel int8 storage for
+    large float matrices (~4x smaller artifact; the loader dequantizes
+    back to f32 at load time — see ``_quantize_int8``).
     """
     import jax
     from jax import export as jax_export
@@ -140,7 +178,14 @@ def export_servable(export_dir, apply_fn, params, example_input,
             jax.jit(serve_fn), platforms=list(platforms)
         )(flat_specs, input_specs)
 
-    payload = dict(flat)
+    quantized = []
+    if quantize == "int8":
+        payload, quantized = _quantize_int8(flat)
+    elif quantize:
+        raise ValueError("unknown quantize mode %r (only 'int8')"
+                         % (quantize,))
+    else:
+        payload = dict(flat)
     table_names = []
     for name, (ids, values) in (embeddings or {}).items():
         payload["emb_ids/" + name] = ids
@@ -170,6 +215,7 @@ def export_servable(export_dir, apply_fn, params, example_input,
         "format": FORMAT,
         "model_name": model_name,
         "version": version,
+        "quantized_int8": sorted(quantized),
         "polymorphic_batch": poly,
         "platforms": list(platforms),
         "parameters": sorted(flat),

@@ -59,7 +59,17 @@ class ServableModel:
                     self.embeddings[name] = (
                         z[key], z["emb_vals/" + name]
                     )
-                elif not key.startswith("emb_vals/"):
+                elif key.startswith("q8/"):
+                    # Weights-only int8: dequantize at load time; the
+                    # StableHLO program takes the f32 weights it was
+                    # traced with (the quantization buys artifact
+                    # size, not compute).
+                    name = key[len("q8/"):]
+                    self.params[name] = (
+                        z[key].astype(np.float32)
+                        * z["q8scale/" + name]
+                    )
+                elif not key.startswith(("emb_vals/", "q8scale/")):
                     self.params[key] = z[key]
         # Sorted-id index per table, built ONCE: lookups are then
         # O(batch log table) via searchsorted instead of rebuilding an
