@@ -54,6 +54,22 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
         return ArrayDataReader(
             (dense, ids, labels), records_per_shard=records_per_shard
         )
+    if data_origin.startswith("tokens:"):
+        # "tokens:<path>:<seq_len>[:<dtype>]" — flat binary token file
+        # (GPT-style pretraining data), memory-mapped windows.
+        import numpy as np
+
+        from elasticdl_tpu.data.token_reader import TokenFileDataReader
+
+        parts = data_origin.split(":")
+        if len(parts) < 3:
+            raise ValueError(
+                "tokens origin needs tokens:<path>:<seq_len>[:<dtype>]")
+        return TokenFileDataReader(
+            parts[1], seq_len=int(parts[2]),
+            dtype=np.dtype(parts[3]) if len(parts) > 3 else np.uint16,
+            records_per_shard=records_per_shard,
+        )
     if data_origin.startswith("imagefolder:"):
         # "imagefolder:<root>[:<image_size>]" — ImageNet-layout dirs.
         from elasticdl_tpu.data.image_folder import ImageFolderDataReader

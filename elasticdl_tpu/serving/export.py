@@ -212,7 +212,12 @@ def export_servable(export_dir, apply_fn, params, example_input,
             is_leaf=lambda s: isinstance(s, dict) and "shape" in s,
         )
     manifest = {
-        "format": FORMAT,
+        # A quantized export gets a PREFIXED format tag: vendored
+        # pre-quantization copies of loader.py (whose check is
+        # startswith(FORMAT-family)) then reject it loudly at LOAD
+        # time instead of failing opaquely inside predict with q8/
+        # params they don't understand.
+        "format": ("int8-weights+" + FORMAT) if quantized else FORMAT,
         "model_name": model_name,
         "version": version,
         "quantized_int8": sorted(quantized),

@@ -48,7 +48,11 @@ class ServableModel:
         with open(os.path.join(export_dir, "manifest.json")) as f:
             self.manifest = json.load(f)
         fmt = self.manifest.get("format", "")
-        if not fmt.startswith("elasticdl_tpu_servable"):
+        # Accept feature-prefixed tags too ("int8-weights+..."): the
+        # prefix exists so OLDER vendored copies of this file reject a
+        # quantized export loudly here rather than failing inside
+        # predict.
+        if "elasticdl_tpu_servable" not in fmt:
             raise ValueError("not a servable export: format=%r" % fmt)
         self.params = {}
         self.embeddings = {}
