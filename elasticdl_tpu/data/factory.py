@@ -65,9 +65,15 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
         if len(parts) < 3:
             raise ValueError(
                 "tokens origin needs tokens:<path>:<seq_len>[:<dtype>]")
+        dtype = parts[3] if len(parts) > 3 else "uint16"
+        if dtype not in ("uint16", "uint32"):
+            # A float or typo'd dtype would memmap the bytes as
+            # garbage and train on noise with no error.
+            raise ValueError(
+                "tokens dtype must be uint16 or uint32, got %r"
+                % dtype)
         return TokenFileDataReader(
-            parts[1], seq_len=int(parts[2]),
-            dtype=np.dtype(parts[3]) if len(parts) > 3 else np.uint16,
+            parts[1], seq_len=int(parts[2]), dtype=np.dtype(dtype),
             records_per_shard=records_per_shard,
         )
     if data_origin.startswith("imagefolder:"):

@@ -30,15 +30,32 @@ from elasticdl_tpu.data.reader import AbstractDataReader
 
 
 def write_token_file(path, tokens, dtype=np.uint16):
-    """Append-or-create a flat binary token file from an id array."""
+    """Append-or-create a flat binary token file from an id array.
+
+    The format is headerless, so a mixed-dtype append would silently
+    byte-misalign every later window: the dtype used at creation is
+    recorded in a ``<path>.meta`` sidecar and appends must match it.
+    """
     tokens = np.asarray(tokens)
     if tokens.size == 0:
         return  # empty document in a tokenize-and-append loop
+    dtype = np.dtype(dtype)
+    meta_path = path + ".meta"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            recorded = f.read().strip()
+        if recorded != dtype.name:
+            raise ValueError(
+                "token file %s was created with dtype %s; appending "
+                "%s would corrupt it" % (path, recorded, dtype.name))
+    else:
+        with open(meta_path, "w") as f:
+            f.write(dtype.name)
     info = np.iinfo(dtype)
     if tokens.min() < info.min or tokens.max() > info.max:
         raise ValueError(
             "token ids [%d, %d] exceed %s range"
-            % (tokens.min(), tokens.max(), np.dtype(dtype).name))
+            % (tokens.min(), tokens.max(), dtype.name))
     with open(path, "ab") as f:
         tokens.astype(dtype).ravel().tofile(f)
 
@@ -49,6 +66,14 @@ class TokenFileDataReader(AbstractDataReader):
         self._path = path
         self._seq_len = int(seq_len)
         self._dtype = np.dtype(dtype)
+        meta_path = path + ".meta"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                recorded = f.read().strip()
+            if recorded != self._dtype.name:
+                raise ValueError(
+                    "token file %s records dtype %s (sidecar); reader "
+                    "asked for %s" % (path, recorded, self._dtype.name))
         self._records_per_shard = records_per_shard
         n_tokens = os.path.getsize(path) // self._dtype.itemsize
         # trailing partial window is dropped (a short record would

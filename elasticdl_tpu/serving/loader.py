@@ -48,12 +48,20 @@ class ServableModel:
         with open(os.path.join(export_dir, "manifest.json")) as f:
             self.manifest = json.load(f)
         fmt = self.manifest.get("format", "")
-        # Accept feature-prefixed tags too ("int8-weights+..."): the
-        # prefix exists so OLDER vendored copies of this file reject a
-        # quantized export loudly here rather than failing inside
-        # predict.
-        if "elasticdl_tpu_servable" not in fmt:
-            raise ValueError("not a servable export: format=%r" % fmt)
+        # Feature prefixes ("int8-weights+<base>") gate loader
+        # capability: THIS copy understands exactly the prefixes
+        # below — an unknown prefix (some future encoding) must fail
+        # HERE, loudly, not deep inside predict with npz keys this
+        # loader mis-files as plain params.
+        *prefixes, base = fmt.split("+")
+        known = {"int8-weights"}
+        if not base.startswith("elasticdl_tpu_servable") or (
+            set(prefixes) - known
+        ):
+            raise ValueError(
+                "not a servable export this loader understands: "
+                "format=%r (known feature prefixes: %s)"
+                % (fmt, sorted(known)))
         self.params = {}
         self.embeddings = {}
         with np.load(os.path.join(export_dir, "model.npz")) as z:

@@ -243,3 +243,33 @@ def test_zero1_snapshot_falls_back_to_fresh_moments(monkeypatch):
     assert big and all(
         np.allclose(np.asarray(leaf), 0) for leaf in big
     )
+
+
+def test_coordinator_factory_failure_defers_commit():
+    """The coordination plane is stood up BEFORE the epoch publishes:
+    a factory failure (port stolen between probe and bind) must NOT
+    commit a new rendezvous_id pointing at the old address — the
+    commit defers, re-arms the grace window, and succeeds on retry."""
+    import time
+
+    from elasticdl_tpu.master.rendezvous import RendezvousServer
+
+    calls = {"n": 0}
+
+    def flaky_factory(world_size):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("address in use")
+        return "jaxsvc://localhost:%d" % (40000 + world_size)
+
+    rdzv = RendezvousServer(grace_secs=0.05,
+                            coordinator_factory=flaky_factory)
+    rdzv.add_worker("w0")
+    time.sleep(0.06)
+    rank, size, epoch, addr = rdzv.get_comm_rank("w0")  # factory fails
+    assert (rank, size, epoch, addr) == (-1, 0, 0, "")
+    time.sleep(0.06)  # grace re-armed; retry succeeds
+    rank, size, epoch, addr = rdzv.get_comm_rank("w0")
+    assert (rank, size, epoch) == (0, 1, 1)
+    assert addr == "jaxsvc://localhost:40001"
+    assert calls["n"] == 2

@@ -462,3 +462,24 @@ def test_int8_quantized_export_roundtrip(tmp_path):
     # near-zero outputs).
     np.testing.assert_allclose(got, want, rtol=0.05, atol=0.6)
     assert np.abs(got - want).max() > 1e-4  # it really quantized
+
+
+def test_loader_rejects_unknown_feature_prefix(tmp_path):
+    """A future feature prefix this loader copy doesn't understand
+    must fail at LOAD time, not deep inside predict."""
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.loader import load_servable
+
+    export_servable(
+        str(tmp_path / "e"), lambda p, x: x * p["s"],
+        {"s": np.float32(2.0)}, np.zeros((1, 2), np.float32),
+        platforms=("cpu",),
+    )
+    manifest_path = str(tmp_path / "e" / "manifest.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest["format"] = "int4-weights+" + manifest["format"]
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="known feature prefixes"):
+        load_servable(str(tmp_path / "e"))

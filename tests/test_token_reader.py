@@ -116,3 +116,21 @@ def test_reader_honors_shuffle_permutation(tmp_path):
             rec, toks[idx * 16:(idx + 1) * 16])
     write_token_file(path, [])  # empty append is a no-op
     assert os.path.getsize(path) == 16 * 6 * 2
+
+
+def test_dtype_sidecar_guards_appends_and_reads(tmp_path):
+    """Headerless format + mixed dtypes would silently corrupt: the
+    .meta sidecar records the creation dtype, mismatched appends and
+    readers fail loudly, and the factory rejects non-integer dtypes."""
+    path = str(tmp_path / "m.bin")
+    write_token_file(path, [1, 2, 3, 4])  # uint16 recorded
+    with pytest.raises(ValueError, match="would corrupt"):
+        write_token_file(path, [5], dtype=np.uint32)
+    with pytest.raises(ValueError, match="sidecar"):
+        TokenFileDataReader(path, seq_len=2, dtype=np.uint32)
+    with pytest.raises(ValueError, match="uint16 or uint32"):
+        create_data_reader("tokens:%s:2:float32" % path)
+    # matching dtype still appends fine
+    write_token_file(path, [5, 6])
+    assert TokenFileDataReader(path, seq_len=2).create_shards() == [
+        (path, 0, 3)]
