@@ -77,15 +77,22 @@ def create_data_reader(data_origin, records_per_shard=256, **kwargs):
             records_per_shard=records_per_shard,
         )
     if data_origin.startswith("imagefolder:"):
-        # "imagefolder:<root>[:<image_size>]" — ImageNet-layout dirs.
+        # "imagefolder:<root>[:<image_size>[:augment]]" —
+        # ImageNet-layout dirs; the optional literal "augment" enables
+        # training-time random crop + horizontal flip.
         from elasticdl_tpu.data.image_folder import ImageFolderDataReader
 
         parts = data_origin.split(":")
         root = parts[1]
         image_size = int(parts[2]) if len(parts) > 2 else 224
+        augment = len(parts) > 3 and parts[3] == "augment"
+        if (len(parts) > 3 and not augment) or len(parts) > 4:
+            raise ValueError(
+                "imagefolder options %r not understood (only a "
+                "single 'augment')" % (parts[3:],))
         return ImageFolderDataReader(
             root, image_size=image_size,
-            records_per_shard=records_per_shard,
+            records_per_shard=records_per_shard, augment=augment,
         )
     if data_origin.endswith(".csv"):
         from elasticdl_tpu.data.reader import TextDataReader

@@ -59,11 +59,45 @@ def load_image(path, image_size):
         return np.asarray(img, np.float32) / 255.0
 
 
+def augment_image(img, rng, crop_padding=0.125):
+    """Standard training augmentation: random resized crop (pad-style
+    — the image is upscaled by ``crop_padding`` and a random
+    image-size window is taken) + random horizontal flip.
+
+    Pure numpy on the HOST, inside the data plane's prefetch threads —
+    augmentation must never be traced into the jitted step (it would
+    either freeze the randomness as constants or force per-step
+    recompiles; the reference likewise augments in the input
+    pipeline).  Output shape equals input shape, so the static-shape
+    contract of the compiled step is untouched.
+    """
+    h, w = img.shape[:2]
+    pad_h = int(round(h * crop_padding))
+    pad_w = int(round(w * crop_padding))
+    if pad_h and pad_w:
+        padded = np.pad(
+            img, ((pad_h, pad_h), (pad_w, pad_w), (0, 0)),
+            mode="reflect")
+        top = rng.randint(0, 2 * pad_h + 1)
+        left = rng.randint(0, 2 * pad_w + 1)
+        img = padded[top:top + h, left:left + w]
+    if rng.rand() < 0.5:
+        img = img[:, ::-1]
+    return np.ascontiguousarray(img)
+
+
 class ImageFolderDataReader(AbstractDataReader):
-    def __init__(self, root, image_size=224, records_per_shard=1024):
+    def __init__(self, root, image_size=224, records_per_shard=1024,
+                 augment=False, seed=None):
+        """``seed=None`` (default) draws fresh OS entropy per process —
+        N workers (and every relaunch) must NOT replay one identical
+        augmentation stream; pass a seed only for reproducibility in
+        tests."""
         self._root = root
         self._image_size = image_size
         self._records_per_shard = records_per_shard
+        self._augment = augment
+        self._rng = np.random.RandomState(seed)
         self.samples, self.class_names = scan_image_folder(root)
 
     @property
@@ -86,16 +120,27 @@ class ImageFolderDataReader(AbstractDataReader):
             start = end
         return shards
 
-    def _record(self, i):
+    def _record(self, i, augment):
         path, label = self.samples[i]
-        return load_image(path, self._image_size), label
+        img = load_image(path, self._image_size)
+        if augment:
+            img = augment_image(img, self._rng)
+        return img, label
 
     def read_records(self, task):
+        from elasticdl_tpu.proto import elastic_pb2 as pb
+
+        # Augment TRAINING records only: evaluation/prediction through
+        # the same reader must see the raw images (random crops would
+        # make validation metrics noisy and non-reproducible).
+        augment = self._augment and (
+            getattr(task, "type", pb.TRAINING) == pb.TRAINING
+        )
         indices = task.shard.record_indices or range(
             task.shard.start, min(task.shard.end, len(self.samples))
         )
         for i in indices:
-            yield self._record(i)
+            yield self._record(i, augment)
 
 
 class ElasticImageFolder:
@@ -132,7 +177,8 @@ class _IndexableFolder:
         self._reader = reader
 
     def __getitem__(self, i):
-        return self._reader._record(i)
+        # Torch-style training dataset: augment iff the reader asks.
+        return self._reader._record(i, self._reader._augment)
 
 
 def pack_image_folder(root, output_dir, image_size=224,

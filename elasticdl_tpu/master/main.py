@@ -30,6 +30,7 @@ _MASTER_ONLY_ARGS = (
     "grads_to_wait", "sync_version_tolerance",
     "worker_backend", "image", "namespace", "worker_resource_request",
     "tpu_topology", "worker_pod_priority", "cluster_spec", "volume",
+    "status_port",
 )
 
 
@@ -218,6 +219,18 @@ def main(argv=None):
     logger.info("master starting: %s", vars(args))
     master = build_master(args)
     master.prepare()
+    status_server = None
+    if args.status_port >= 0:
+        from elasticdl_tpu.master.status_server import StatusServer
+
+        status_server = StatusServer(
+            master.task_manager,
+            worker_manager=master.worker_manager,
+            rendezvous_server=master.rendezvous_server,
+            servicer=master.servicer,
+            port=args.status_port,
+        )
+        status_server.start()
     if getattr(master, "ps_manager", None) is not None:
         master.ps_manager._master_addr = "localhost:%d" % master.port
         master.ps_manager.start()
@@ -226,6 +239,8 @@ def main(argv=None):
     finally:
         if getattr(master, "ps_manager", None) is not None:
             master.ps_manager.stop()
+        if status_server is not None:
+            status_server.stop()
 
 
 if __name__ == "__main__":

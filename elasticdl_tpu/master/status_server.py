@@ -1,0 +1,133 @@
+"""Master HTTP status endpoint — the operator observability surface.
+
+The reference surfaces job state through logs and the k8s API (pod
+phases, the job monitor); this gives operators and probes a direct
+pull surface on the master itself:
+
+  GET /healthz   -> 200 "ok" (liveness/readiness probe target)
+  GET /status    -> JSON: task counts (todo/doing/completed/failed,
+                    epoch), live workers, rendezvous epoch + world,
+                    worker exec counters
+  GET /metrics   -> the same numbers in Prometheus text exposition
+                    format (elasticdl_tasks_todo, ..._completed{type=},
+                    elasticdl_workers_live, elasticdl_rendezvous_epoch)
+
+Stdlib-only (ThreadingHTTPServer), read-only, zero coupling into the
+control plane beyond the objects it snapshots.  Enabled with
+``--status_port`` (master flag); port 0 picks a free one.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def collect_status(task_manager, worker_manager=None,
+                   rendezvous_server=None, servicer=None):
+    status = {"tasks": task_manager.counts(),
+              "finished": task_manager.finished()}
+    if worker_manager is not None:
+        status["workers"] = {
+            "live": sorted(worker_manager.live_worker_ids()),
+        }
+    if rendezvous_server is not None:
+        status["rendezvous"] = {
+            "epoch": rendezvous_server.rendezvous_id,
+            "world": rendezvous_server.world,
+        }
+    if servicer is not None:
+        status["exec_counters"] = dict(servicer.worker_exec_counters)
+    return status
+
+
+def to_prometheus(status):
+    lines = []
+
+    def gauge(metric, value, **labels):
+        label_str = ""
+        if labels:
+            label_str = "{%s}" % ",".join(
+                '%s="%s"' % kv for kv in sorted(labels.items()))
+        lines.append("%s%s %s" % (metric, label_str, value))
+
+    tasks = status["tasks"]
+    gauge("elasticdl_tasks_todo", tasks["todo"])
+    gauge("elasticdl_tasks_doing", tasks["doing"])
+    gauge("elasticdl_data_epoch", tasks["epoch"])
+    for kind in ("completed", "failed"):
+        for task_type, count in tasks[kind].items():
+            gauge("elasticdl_tasks_%s" % kind, count,
+                  type=str(task_type))
+    gauge("elasticdl_job_finished", int(status["finished"]))
+    if "workers" in status:
+        gauge("elasticdl_workers_live", len(status["workers"]["live"]))
+    if "rendezvous" in status:
+        gauge("elasticdl_rendezvous_epoch",
+              status["rendezvous"]["epoch"])
+        gauge("elasticdl_rendezvous_world_size",
+              len(status["rendezvous"]["world"]))
+    for name, value in status.get("exec_counters", {}).items():
+        gauge("elasticdl_worker_counter", value, name=name)
+    return "\n".join(lines) + "\n"
+
+
+class StatusServer:
+    def __init__(self, task_manager, worker_manager=None,
+                 rendezvous_server=None, servicer=None, port=0,
+                 host="0.0.0.0"):
+        self._sources = dict(
+            task_manager=task_manager, worker_manager=worker_manager,
+            rendezvous_server=rendezvous_server, servicer=servicer,
+        )
+        sources = self._sources
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("status: " + fmt, *args)
+
+            def _reply(self, code, body, content_type):
+                payload = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, "ok\n", "text/plain")
+                try:
+                    status = collect_status(**sources)
+                except Exception as e:  # noqa: BLE001 — a probe must
+                    # get a 500, not a dropped connection
+                    return self._reply(500, "error: %s\n" % e,
+                                       "text/plain")
+                if self.path == "/status":
+                    return self._reply(200, json.dumps(status),
+                                       "application/json")
+                if self.path == "/metrics":
+                    return self._reply(
+                        200, to_prometheus(status),
+                        "text/plain; version=0.0.4")
+                return self._reply(404, "unknown path %s\n" % self.path,
+                                   "text/plain")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="status-http",
+            daemon=True,
+        )
+
+    def start(self):
+        self._thread.start()
+        logger.info("status server on port %d "
+                    "(/healthz /status /metrics)", self.port)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -82,6 +82,10 @@ def build_master_parser():
     parser.add_argument("--cluster_spec", default="",
                         help="dotted module with patch_pod/patch_service "
                              "hooks")
+    parser.add_argument("--status_port", type=int, default=-1,
+                        help="HTTP observability port on the master "
+                             "(/healthz /status /metrics); 0 = any "
+                             "free port, -1 (default) = disabled")
     parser.add_argument("--volume", default="",
                         help="pod volume mounts, reference syntax: "
                              "'claim_name=c,mount_path=/p;"

@@ -122,3 +122,39 @@ def test_elastic_image_folder_consumes_master_indices(folder):
     samples, _ = scan_image_folder(folder)
     assert (y0, y1) == (samples[3][1], samples[7][1])
     ds.stop()
+
+
+def test_augmentation_preserves_shape_and_varies(folder):
+    """augment: random crop + flip on the HOST — output shape is the
+    jitted step's static shape, repeated reads differ, and the factory
+    origin's :augment option (only) enables it."""
+    from elasticdl_tpu.data.factory import create_data_reader
+    from elasticdl_tpu.data.image_folder import augment_image
+
+    rng = np.random.RandomState(0)
+    img = np.arange(8 * 8 * 3, dtype=np.float32).reshape(8, 8, 3)
+    outs = [augment_image(img, rng) for _ in range(8)]
+    assert all(o.shape == img.shape for o in outs)
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+    plain = create_data_reader("imagefolder:%s:16" % folder)
+    aug = create_data_reader("imagefolder:%s:16:augment" % folder)
+    task = Task(0, Shard(folder, 0, 2), 0)
+    a = [r[0] for r in plain.read_records(task)]
+    b = [r[0] for r in aug.read_records(task)]
+    assert a[0].shape == b[0].shape == (16, 16, 3)
+    assert not all(
+        np.array_equal(x, y) for x, y in zip(a, b)
+    )  # augmentation actually perturbed something
+    with pytest.raises(ValueError, match="augment"):
+        create_data_reader("imagefolder:%s:16:flip" % folder)
+    with pytest.raises(ValueError, match="augment"):
+        create_data_reader("imagefolder:%s:16:augment:noflip" % folder)
+
+    # eval/predict tasks through the SAME augmented reader get raw
+    # images (deterministic metrics)
+    eval_task = Task(0, Shard(folder, 0, 2), 1)  # EVALUATION
+    raw = [r[0] for r in plain.read_records(task)]
+    ev = [r[0] for r in aug.read_records(eval_task)]
+    for x, y in zip(raw, ev):
+        np.testing.assert_array_equal(x, y)

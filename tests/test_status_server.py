@@ -1,0 +1,83 @@
+"""Master HTTP observability surface (master/status_server.py):
+/healthz, /status JSON, /metrics Prometheus text."""
+
+import json
+import urllib.request
+
+from elasticdl_tpu.master.status_server import (
+    StatusServer,
+    to_prometheus,
+)
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from tests.test_utils import create_master, create_master_client
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        "http://127.0.0.1:%d%s" % (port, path), timeout=10
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_status_endpoints_reflect_job_state():
+    master = create_master(
+        training_shards=[("f", 0, 64)], records_per_task=16,
+        rendezvous=True,
+    )
+    server = StatusServer(
+        master.task_manager,
+        rendezvous_server=master.rendezvous_server,
+        servicer=master.servicer,
+        host="127.0.0.1",
+    )
+    server.start()
+    try:
+        code, body = _get(server.port, "/healthz")
+        assert (code, body) == (200, "ok\n")
+
+        mc = create_master_client(master, worker_id=0)
+        mc.report_train_loop_status(pb.LOOP_START)
+        task = mc.get_task()
+        mc.report_task_result(task.id)  # one task completed
+
+        code, body = _get(server.port, "/status")
+        assert code == 200
+        status = json.loads(body)
+        assert status["tasks"]["completed"][str(pb.TRAINING)] == 1
+        assert status["tasks"]["todo"] == 3
+        assert status["finished"] is False
+        assert status["rendezvous"]["world"] in ([], ["worker-0"])
+
+        code, text = _get(server.port, "/metrics")
+        assert code == 200
+        metrics = dict(
+            line.rsplit(" ", 1) for line in text.strip().splitlines()
+        )
+        assert metrics["elasticdl_tasks_todo"] == "3"
+        assert metrics['elasticdl_tasks_completed{type="0"}'] == "1"
+        assert metrics["elasticdl_job_finished"] == "0"
+
+        code, _ = _get(server.port, "/nope")
+        assert code == 404
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+    finally:
+        server.stop()
+        master.stop()
+
+
+def test_prometheus_rendering_shapes():
+    status = {
+        "tasks": {"todo": 2, "doing": 1, "epoch": 0,
+                  "completed": {0: 5}, "failed": {0: 0}},
+        "finished": False,
+        "workers": {"live": [0, 2]},
+        "rendezvous": {"epoch": 3, "world": ["a", "b"]},
+        "exec_counters": {"batch_count": 17},
+    }
+    text = to_prometheus(status)
+    assert 'elasticdl_tasks_completed{type="0"} 5' in text
+    assert "elasticdl_workers_live 2" in text
+    assert "elasticdl_rendezvous_world_size 2" in text
+    assert 'elasticdl_worker_counter{name="batch_count"} 17' in text
