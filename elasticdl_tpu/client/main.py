@@ -172,6 +172,109 @@ def _zoo_push(args):
     return subprocess.call(cmd)
 
 
+def _inspect(args):
+    """Operator tooling: summarize an export or checkpoint directory.
+
+    Detects servable exports (manifest.json, incl. versioned
+    ``<base>/<N>/`` layouts — inspects the latest) and checkpoint dirs
+    (``version-*``) and prints params/tables/sizes so operators don't
+    spelunk npz files by hand.
+    """
+    import numpy as np
+
+    path = args.path
+
+    def _fmt_bytes(n):
+        for unit in ("B", "KB", "MB", "GB"):
+            if n < 1024 or unit == "GB":
+                return "%.1f %s" % (n, unit)
+            n /= 1024.0
+
+    versions = sorted(
+        int(e) for e in os.listdir(path)
+        if e.isdigit() and os.path.isfile(
+            os.path.join(path, e, "manifest.json"))
+    ) if os.path.isdir(path) else []
+    target = os.path.join(path, str(versions[-1])) if versions else path
+    if os.path.isfile(os.path.join(target, "manifest.json")):
+        import json as _json
+
+        with open(os.path.join(target, "manifest.json")) as f:
+            manifest = _json.load(f)
+        print("servable export: %s" % target)
+        if versions:
+            print("  versions on disk: %s (latest shown)" % versions)
+        for key in ("format", "model_name", "version",
+                    "polymorphic_batch", "platforms"):
+            print("  %s: %s" % (key, manifest.get(key)))
+        quantized = manifest.get("quantized_int8") or []
+        if quantized:
+            print("  int8-quantized: %s" % ", ".join(quantized))
+        npz_path = os.path.join(target, "model.npz")
+        # Header-only scan: shapes/dtypes come from each member's npy
+        # header, so inspecting a multi-GB export never materializes
+        # an array.  int8-quantized entries count at float32 size in
+        # the in-memory figure (both loaders dequantize at load).
+        import zipfile
+
+        total = 0
+        n_params = 0
+        tables = {}
+        with zipfile.ZipFile(npz_path) as zf:
+            for info in zf.infolist():
+                key = info.filename[:-4]  # strip ".npy"
+                with zf.open(info) as member:
+                    np.lib.format.read_magic(member)
+                    shape, _f, dtype = (
+                        np.lib.format.read_array_header_1_0(member))
+                nbytes = int(np.prod(shape)) * dtype.itemsize
+                if key.startswith(("q8/", "q8emb/")):
+                    nbytes *= 4  # dequantized to float32 in memory
+                total += nbytes
+                if key.startswith("emb_ids/"):
+                    tables[key[len("emb_ids/"):]] = int(shape[0])
+                elif not key.startswith(
+                    ("emb_vals/", "q8emb/", "q8embscale/", "q8scale/")
+                ):
+                    n_params += 1
+        print("  parameters: %d arrays, weights file %s on disk"
+              % (n_params, _fmt_bytes(os.path.getsize(npz_path))))
+        print("  in-memory (dequantized): %s" % _fmt_bytes(total))
+        for name, rows in sorted(tables.items()):
+            print("  table %s: %d rows" % (name, rows))
+        return 0
+
+    from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+    entries = sorted(
+        e for e in os.listdir(path) if e.startswith("version-")
+    ) if os.path.isdir(path) else []
+    if not entries:
+        print("nothing to inspect at %s (no manifest.json, no "
+              "version-* checkpoints)" % path)
+        return 1
+    print("checkpoint dir: %s" % path)
+    for entry in entries:
+        vdir = os.path.join(path, entry)
+        shards = sorted(os.listdir(vdir))
+        size = sum(
+            os.path.getsize(os.path.join(vdir, s)) for s in shards
+        )
+        print("  %s: %d shard file(s), %s"
+              % (entry, len(shards), _fmt_bytes(size)))
+    saver = CheckpointSaver(path)
+    try:
+        dense, embeddings, version = saver.load()
+        n_opt = sum(1 for k in dense if k.startswith("opt/")
+                    or k.startswith("optslot/"))
+        print("  latest loadable: version %d — %d dense arrays "
+              "(%d optimizer), %d embedding tables"
+              % (version, len(dense), n_opt, len(embeddings)))
+    except Exception as e:  # noqa: BLE001 — partial/corrupt dirs
+        print("  latest not loadable: %s" % e)
+    return 0
+
+
 def build_parser():
     parser = argparse.ArgumentParser(
         "elasticdl-tpu",
@@ -200,6 +303,9 @@ def build_parser():
         help="serve a servable export over HTTP "
              "(--export_dir DIR [--port P] [--model_name N])",
     )
+    p = sub.add_parser(
+        "inspect", help="summarize an export or checkpoint directory")
+    p.add_argument("path")
     return parser
 
 
@@ -217,6 +323,8 @@ def main(argv=None):
 
         return serve_main(argv[1:])
     args = parser.parse_args(argv)
+    if args.command == "inspect":
+        return _inspect(args)
     if args.command == "zoo":
         if args.zoo_command == "init":
             return _zoo_init(args)

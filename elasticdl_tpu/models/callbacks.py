@@ -122,23 +122,12 @@ class ModelExporter:
 
 def load_export(export_dir):
     """Load an export back into ({name: array}, {table: (ids, values)});
-    int8-quantized weights (``q8/`` keys) dequantize transparently, so
-    a quantized export works everywhere a full one does (e.g. as a
-    LoRA ``base_export``)."""
-    dense = {}
-    embeddings = {}
-    with np.load(os.path.join(export_dir, "model.npz")) as z:
-        for key in z.files:
-            if key.startswith("emb_ids/"):
-                name = key[len("emb_ids/"):]
-                embeddings[name] = (z[key], z["emb_vals/" + name])
-            elif key.startswith("q8/"):
-                name = key[len("q8/"):]
-                dense[name] = (z[key].astype(np.float32)
-                               * z["q8scale/" + name])
-            elif not key.startswith(("emb_vals/", "q8scale/")):
-                dense[key] = z[key]
-    return dense, embeddings
+    int8-quantized weights and tables dequantize transparently, so a
+    quantized export works everywhere a full one does (e.g. as a LoRA
+    ``base_export``).  One shared decode: serving.export.load_payload."""
+    from elasticdl_tpu.serving.export import load_payload
+
+    return load_payload(export_dir)
 
 
 class LearningRateScheduler:

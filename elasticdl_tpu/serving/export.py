@@ -50,7 +50,20 @@ def _signature(tree):
     )
 
 
-def _quantize_int8(flat, min_elems=4096):
+def _quantize_rows(arr):
+    """Per-last-axis symmetric int8: (q, scale) — the ONE quantize
+    core shared by the dense and embedding paths."""
+    scale = np.maximum(
+        np.abs(arr).max(axis=-1, keepdims=True) / 127.0, 1e-12
+    ).astype(np.float32)
+    q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+QUANTIZE_MIN_ELEMS = 4096
+
+
+def _quantize_int8(flat, min_elems=QUANTIZE_MIN_ELEMS):
     """Weights-only per-channel symmetric int8 for large float arrays.
 
     Returns ({name: payload_arrays}, [quantized names]).  Each
@@ -75,13 +88,40 @@ def _quantize_int8(flat, min_elems=4096):
         ):
             payload[name] = arr
             continue
-        scale = np.abs(arr).max(axis=-1, keepdims=True) / 127.0
-        scale = np.maximum(scale, 1e-12).astype(np.float32)
-        q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+        q, scale = _quantize_rows(arr)
         payload["q8/" + name] = q
         payload["q8scale/" + name] = scale
         quantized.append(name)
     return payload, quantized
+
+
+def load_payload(export_dir):
+    """(dense, embeddings) from an export's ``model.npz``, dequantizing
+    every encoding this framework writes — the framework-side decode
+    twin of the standalone loader (which carries its own copy BY
+    DESIGN: it must stay vendorable with zero framework imports).
+    Non-standalone callers (callbacks.load_export, tools) share THIS
+    one, so a new encoding is two coordinated edits, not four."""
+    dense = {}
+    embeddings = {}
+    with np.load(os.path.join(export_dir, "model.npz")) as z:
+        for key in z.files:
+            if key.startswith("emb_ids/"):
+                name = key[len("emb_ids/"):]
+                if "emb_vals/" + name in z:
+                    values = z["emb_vals/" + name]
+                else:  # int8-quantized table
+                    values = (z["q8emb/" + name].astype(np.float32)
+                              * z["q8embscale/" + name])
+                embeddings[name] = (z[key], values)
+            elif key.startswith("q8/"):
+                name = key[len("q8/"):]
+                dense[name] = (z[key].astype(np.float32)
+                               * z["q8scale/" + name])
+            elif not key.startswith(("emb_vals/", "q8scale/",
+                                     "q8emb/", "q8embscale/")):
+                dense[key] = z[key]
+    return dense, embeddings
 
 
 def export_servable(export_dir, apply_fn, params, example_input,
@@ -187,9 +227,23 @@ def export_servable(export_dir, apply_fn, params, example_input,
     else:
         payload = dict(flat)
     table_names = []
+    emb_quantized = False
     for name, (ids, values) in (embeddings or {}).items():
         payload["emb_ids/" + name] = ids
-        payload["emb_vals/" + name] = values
+        values = np.asarray(values)
+        if quantize == "int8" and values.ndim == 2 and (
+            values.dtype == np.float32
+            and values.size >= QUANTIZE_MIN_ELEMS
+        ):
+            # Embedding tables dominate CTR-model artifacts; the same
+            # per-row symmetric int8 applies (rows are the channels).
+            q, scale = _quantize_rows(values)
+            payload["q8emb/" + name] = q
+            payload["q8embscale/" + name] = scale
+            quantized.append("emb:" + name)
+            emb_quantized = True
+        else:
+            payload["emb_vals/" + name] = values
         table_names.append(name)
     with open(os.path.join(export_dir, "model.npz"), "wb") as f:
         np.savez(f, **payload)
@@ -211,13 +265,18 @@ def export_servable(export_dir, apply_fn, params, example_input,
             _free_batch, signature,
             is_leaf=lambda s: isinstance(s, dict) and "shape" in s,
         )
+    # A quantized export gets PREFIXED format tags: vendored loader
+    # copies that predate an encoding then reject it loudly at LOAD
+    # time instead of failing opaquely mid-init/predict.  Quantized
+    # embedding tables get their OWN prefix — a loader that knows
+    # int8-weights but not int8-emb must still refuse.
+    fmt = FORMAT
+    if quantized:
+        fmt = "int8-weights+" + fmt
+    if emb_quantized:
+        fmt = "int8-emb+" + fmt
     manifest = {
-        # A quantized export gets a PREFIXED format tag: vendored
-        # pre-quantization copies of loader.py (whose check is
-        # startswith(FORMAT-family)) then reject it loudly at LOAD
-        # time instead of failing opaquely inside predict with q8/
-        # params they don't understand.
-        "format": ("int8-weights+" + FORMAT) if quantized else FORMAT,
+        "format": fmt,
         "model_name": model_name,
         "version": version,
         "quantized_int8": sorted(quantized),

@@ -54,7 +54,7 @@ class ServableModel:
         # HERE, loudly, not deep inside predict with npz keys this
         # loader mis-files as plain params.
         *prefixes, base = fmt.split("+")
-        known = {"int8-weights"}
+        known = {"int8-weights", "int8-emb"}
         if not base.startswith("elasticdl_tpu_servable") or (
             set(prefixes) - known
         ):
@@ -68,9 +68,12 @@ class ServableModel:
             for key in z.files:
                 if key.startswith("emb_ids/"):
                     name = key[len("emb_ids/"):]
-                    self.embeddings[name] = (
-                        z[key], z["emb_vals/" + name]
-                    )
+                    if "emb_vals/" + name in z:
+                        values = z["emb_vals/" + name]
+                    else:  # int8-quantized table: dequantize per row
+                        values = (z["q8emb/" + name].astype(np.float32)
+                                  * z["q8embscale/" + name])
+                    self.embeddings[name] = (z[key], values)
                 elif key.startswith("q8/"):
                     # Weights-only int8: dequantize at load time; the
                     # StableHLO program takes the f32 weights it was
@@ -81,7 +84,8 @@ class ServableModel:
                         z[key].astype(np.float32)
                         * z["q8scale/" + name]
                     )
-                elif not key.startswith(("emb_vals/", "q8scale/")):
+                elif not key.startswith(("emb_vals/", "q8scale/",
+                                         "q8emb/", "q8embscale/")):
                     self.params[key] = z[key]
         # Sorted-id index per table, built ONCE: lookups are then
         # O(batch log table) via searchsorted instead of rebuilding an

@@ -168,3 +168,39 @@ def test_cli_serve_end_to_end(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_inspect_export_and_checkpoint(tmp_path, capsys):
+    """`elasticdl-tpu inspect` summarizes servable exports (incl.
+    versioned + quantized) and checkpoint dirs."""
+    import numpy as np
+
+    from elasticdl_tpu.client.main import main as cli_main
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+    base = str(tmp_path / "models")
+    rng = np.random.RandomState(0)
+    for version in (1, 3):
+        export_servable(
+            os.path.join(base, str(version)),
+            lambda p, x: x @ p["w"],
+            {"w": rng.randn(128, 64).astype(np.float32)},
+            np.zeros((1, 128), np.float32), model_name="m",
+            version=version, platforms=("cpu",), quantize="int8",
+        )
+    assert cli_main(["inspect", base]) == 0
+    out = capsys.readouterr().out
+    assert "versions on disk: [1, 3]" in out
+    assert "int8-quantized: w" in out
+    assert "model_name: m" in out
+
+    ckpt = str(tmp_path / "ckpt")
+    saver = CheckpointSaver(ckpt)
+    saver.save(7, dense={"w": np.ones(4, np.float32),
+                         "opt/w": np.zeros(4, np.float32)})
+    assert cli_main(["inspect", ckpt]) == 0
+    out = capsys.readouterr().out
+    assert "version-" in out and "latest loadable: version 7" in out
+
+    assert cli_main(["inspect", str(tmp_path / "nope")]) == 1

@@ -483,3 +483,39 @@ def test_loader_rejects_unknown_feature_prefix(tmp_path):
         json.dump(manifest, f)
     with pytest.raises(ValueError, match="known feature prefixes"):
         load_servable(str(tmp_path / "e"))
+
+
+def test_int8_quantized_embedding_tables(tmp_path):
+    """quantize='int8' also covers embedding tables (the dominant CTR
+    artifact): per-row int8 storage, transparent dequant in BOTH
+    loaders, lookups within rounding noise; tiny tables ride through
+    exact."""
+    from elasticdl_tpu.models.callbacks import load_export
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.loader import load_servable
+
+    rng = np.random.RandomState(0)
+    big_vals = rng.randn(1024, 16).astype(np.float32)
+    small_vals = rng.randn(3, 4).astype(np.float32)
+    manifest = export_servable(
+        str(tmp_path / "e"),
+        lambda p, x: x * p["s"],
+        {"s": np.float32(1.0)},
+        np.zeros((2, 3), np.float32),
+        embeddings={
+            "items": (np.arange(1024), big_vals),
+            "tiny": (np.array([5, 9, 11]), small_vals),
+        },
+        platforms=("cpu",), quantize="int8",
+    )
+    assert manifest["quantized_int8"] == ["emb:items"]
+    model = load_servable(str(tmp_path / "e"))
+    rows = model.lookup_embedding("items", [0, 7, 1023])
+    np.testing.assert_allclose(
+        rows, big_vals[[0, 7, 1023]], rtol=0.02, atol=0.05)
+    np.testing.assert_array_equal(
+        model.lookup_embedding("tiny", [9]), small_vals[[1]])
+    # load_export (the training-side loader) dequantizes too
+    _, embeddings = load_export(str(tmp_path / "e"))
+    np.testing.assert_allclose(
+        embeddings["items"][1], big_vals, rtol=0.02, atol=0.05)
