@@ -190,12 +190,17 @@ def _inspect(args):
                 return "%.1f %s" % (n, unit)
             n /= 1024.0
 
+    from elasticdl_tpu.serving.loader import resolve_export_dir
+
     versions = sorted(
         int(e) for e in os.listdir(path)
         if e.isdigit() and os.path.isfile(
             os.path.join(path, e, "manifest.json"))
-    ) if os.path.isdir(path) else []
-    target = os.path.join(path, str(versions[-1])) if versions else path
+    ) if os.path.isdir(path) else []  # display only; resolution below
+    try:
+        target = resolve_export_dir(path)  # the ONE canonical scan
+    except (FileNotFoundError, NotADirectoryError):
+        target = path
     if os.path.isfile(os.path.join(target, "manifest.json")):
         import json as _json
 

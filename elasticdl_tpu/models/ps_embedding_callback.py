@@ -1,0 +1,130 @@
+"""PS embedding lookups INSIDE the jitted step — callback + custom VJP.
+
+SURVEY §7 names this the fiddly hard part: the reference routes
+embedding lookups through ``tf.py_function`` and re-wires the tape so
+sparse gradients flow back to the parameter server
+(elasticdl/python/elasticdl/layers/embedding.py +
+embedding_delegate.py:232-281).  The framework's DEFAULT design avoids
+the problem entirely (the emb__/idx__ convention: the trainer pulls
+rows on the host and feeds them as pure jit inputs).  This module is
+the direct JAX analog of the reference mechanism for when the lookup
+must live inside the compiled step:
+
+ - forward: ``jax.pure_callback`` pulls rows from the PS mid-step
+   (shape-static: [B] ids -> [B, dim] f32);
+ - backward: a ``custom_vjp`` whose bwd rule fires an ORDERED
+   ``io_callback`` pushing the sparse gradient straight to the PS (the
+   async-SGD push — duplicate ids merge server-side), and returns a
+   float0 cotangent for the integer ids;
+ - **the table handle**: reverse AD only evaluates a VJP on paths that
+   reach a differentiated input, and PS rows depend on no local
+   parameter — the exact gap TF's tape bridges with
+   ``tape.watch(embedding_output)``.  The JAX-idiomatic bridge: the
+   table is represented IN the param pytree by a scalar ``handle``
+   (``PSEmbedding.handle``, value 0.0, gradient 0.0 — optimizers
+   no-op on it), and ``lookup(ids, handle)`` threads it through, so
+   the output cotangent must flow through the lookup and the bwd push
+   fires.
+
+Trade-offs vs the default design (documented, measured by the data
+plane bench): a host round-trip inside every step (the reference pays
+the same via py_function) and push-on-backward semantics (the PS
+applies the update immediately — async mode; in sync mode pair it
+with grads_to_wait as usual).  Use the default host-pulled design
+unless the table cannot be staged per-batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PSEmbedding:
+    """One PS-backed table, usable inside jitted train/eval steps.
+
+    ``lookup(ids)`` is differentiable: its backward pushes the sparse
+    gradient to the PS.  ``version_fn`` supplies the gradient version
+    for staleness handling (defaults to 0 — pure async)."""
+
+    def __init__(self, ps_client, table, dim, learning_rate=0.0,
+                 version_fn=None):
+        self._ps = ps_client
+        self._table = table
+        self._dim = int(dim)
+        self._learning_rate = learning_rate
+        self._version_fn = version_fn or (lambda: 0)
+
+        def _pull(ids):
+            rows = self._ps.pull_embedding_vectors(
+                self._table, np.asarray(ids, np.int64).ravel())
+            return np.asarray(rows, np.float32).reshape(
+                ids.shape + (self._dim,))
+
+        def _push(ids, grads):
+            version = int(self._version_fn())
+            accepted, server_version = self._ps.push_gradients(
+                {},
+                {self._table: (
+                    np.asarray(grads, np.float32).reshape(
+                        -1, self._dim),
+                    np.asarray(ids, np.int64).ravel(),
+                )},
+                version=version,
+                learning_rate=self._learning_rate,
+            )
+            if not accepted:
+                # Sync-mode staleness reject: the minibatch's table
+                # update is DROPPED (the dense path re-pulls and
+                # retries; a backward-pass push has no retry point) —
+                # at least say so instead of silently not learning.
+                logger.warning(
+                    "PS rejected embedding push for %r (grad version "
+                    "%d vs server %s); table update dropped",
+                    self._table, version, server_version)
+            return np.zeros((), np.int32)  # io_callback token
+
+        def _call_pull(ids):
+            return jax.pure_callback(
+                _pull,
+                jax.ShapeDtypeStruct(ids.shape + (self._dim,),
+                                     jnp.float32),
+                ids,
+            )
+
+        @jax.custom_vjp
+        def lookup(ids, handle):
+            del handle  # differentiation hook only (see module doc)
+            return _call_pull(ids)
+
+        def fwd(ids, handle):
+            del handle
+            return _call_pull(ids), ids
+
+        def bwd(ids, g):
+            # Ordered: pushes must not be elided or reordered — they
+            # ARE the training update for this table.
+            jax.experimental.io_callback(
+                _push, jax.ShapeDtypeStruct((), jnp.int32), ids, g,
+                ordered=True,
+            )
+            # Integer ids take a float0 cotangent; the handle's
+            # cotangent is zero (the "weights" live on the PS).
+            return (np.zeros(ids.shape, jax.dtypes.float0),
+                    jnp.zeros((), jnp.float32))
+
+        lookup.defvjp(fwd, bwd)
+        self._lookup = lookup
+
+    @property
+    def handle(self):
+        """Put this in the param pytree and thread it into
+        ``__call__``: it is what routes the loss cotangent through the
+        lookup so the backward push fires."""
+        return jnp.zeros((), jnp.float32)
+
+    def __call__(self, ids, handle):
+        return self._lookup(jnp.asarray(ids), handle)

@@ -227,7 +227,8 @@ def export_servable(export_dir, apply_fn, params, example_input,
     else:
         payload = dict(flat)
     table_names = []
-    emb_quantized = False
+    emb_quantized = []  # SEPARATE from the dense list: each format
+    # prefix must reflect exactly the encodings present in the file
     for name, (ids, values) in (embeddings or {}).items():
         payload["emb_ids/" + name] = ids
         values = np.asarray(values)
@@ -240,8 +241,7 @@ def export_servable(export_dir, apply_fn, params, example_input,
             q, scale = _quantize_rows(values)
             payload["q8emb/" + name] = q
             payload["q8embscale/" + name] = scale
-            quantized.append("emb:" + name)
-            emb_quantized = True
+            emb_quantized.append("emb:" + name)
         else:
             payload["emb_vals/" + name] = values
         table_names.append(name)
@@ -275,6 +275,7 @@ def export_servable(export_dir, apply_fn, params, example_input,
         fmt = "int8-weights+" + fmt
     if emb_quantized:
         fmt = "int8-emb+" + fmt
+    quantized = quantized + emb_quantized  # manifest lists both kinds
     manifest = {
         "format": fmt,
         "model_name": model_name,
