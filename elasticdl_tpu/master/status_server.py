@@ -75,16 +75,13 @@ def to_prometheus(status):
     return "\n".join(lines) + "\n"
 
 
-class StatusServer:
-    def __init__(self, task_manager, worker_manager=None,
-                 rendezvous_server=None, servicer=None, port=0,
-                 host="0.0.0.0"):
-        self._sources = dict(
-            task_manager=task_manager, worker_manager=worker_manager,
-            rendezvous_server=rendezvous_server, servicer=servicer,
-        )
-        sources = self._sources
+class HttpStatusServer:
+    """Generic /healthz /status /metrics server over a collect_fn
+    (returns the JSON-able status dict) and a prom_fn (renders it as
+    Prometheus text).  The master's StatusServer and the PS's metrics
+    endpoint are both instances."""
 
+    def __init__(self, collect_fn, prom_fn, port=0, host="0.0.0.0"):
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 logger.debug("status: " + fmt, *args)
@@ -101,7 +98,7 @@ class StatusServer:
                 if self.path == "/healthz":
                     return self._reply(200, "ok\n", "text/plain")
                 try:
-                    status = collect_status(**sources)
+                    status = collect_fn()
                 except Exception as e:  # noqa: BLE001 — a probe must
                     # get a 500, not a dropped connection
                     return self._reply(500, "error: %s\n" % e,
@@ -111,7 +108,7 @@ class StatusServer:
                                        "application/json")
                 if self.path == "/metrics":
                     return self._reply(
-                        200, to_prometheus(status),
+                        200, prom_fn(status),
                         "text/plain; version=0.0.4")
                 return self._reply(404, "unknown path %s\n" % self.path,
                                    "text/plain")
@@ -131,3 +128,17 @@ class StatusServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class StatusServer(HttpStatusServer):
+    def __init__(self, task_manager, worker_manager=None,
+                 rendezvous_server=None, servicer=None, port=0,
+                 host="0.0.0.0"):
+        super().__init__(
+            lambda: collect_status(
+                task_manager, worker_manager=worker_manager,
+                rendezvous_server=rendezvous_server,
+                servicer=servicer,
+            ),
+            to_prometheus, port=port, host=host,
+        )

@@ -87,6 +87,35 @@ class ParameterServer:
         self._server.start()
         logger.info("PS %d/%d listening on port %d",
                     self.args.ps_id, self.args.num_ps, self.port)
+        if getattr(self.args, "status_port", -1) >= 0:
+            from elasticdl_tpu.master.status_server import (
+                HttpStatusServer,
+            )
+
+            def collect():
+                return {
+                    "ps_id": self.args.ps_id,
+                    "num_ps": self.args.num_ps,
+                    "version": self.parameters.version,
+                    "initialized": self.parameters.initialized,
+                    "counters": dict(self.servicer.counters),
+                }
+
+            def prom(status):
+                lines = [
+                    "elasticdl_ps_version %d" % status["version"],
+                    "elasticdl_ps_initialized %d"
+                    % int(status["initialized"]),
+                ] + [
+                    'elasticdl_ps_requests{kind="%s"} %d' % kv
+                    for kv in sorted(status["counters"].items())
+                ]
+                return "\n".join(lines) + "\n"
+
+            self._status_server = HttpStatusServer(collect, prom,
+                                                   port=self.args.
+                                                   status_port)
+            self._status_server.start()
         if self._master_client is not None:
             # Self-terminate when the master goes away (reference: the Go
             # PS polls the master pod every 30s, k8s_client.go:42-60) so
@@ -127,6 +156,9 @@ class ParameterServer:
                 # a kill deadline
                 logger.error("preemption checkpoint failed: %s", e)
         self._done.set()
+        if getattr(self, "_status_server", None) is not None:
+            self._status_server.stop()
+            self._status_server = None
         if self._server is not None:
             self._server.stop(grace=1)
             self._server = None

@@ -56,6 +56,11 @@ class PserverServicer:
         self._grad_buffer = []   # [(dense, embeddings)] awaiting sync apply
         self._staged = {}        # txn_id -> (dense, emb, lr, stage_time)
         self._staged_ttl = 60.0  # abandon prepares from dead workers
+        # Observability counters (ps/server.py --status_port): plain
+        # int bumps — pull_embedding is deliberately lock-free, so its
+        # counter tolerates the (benign, CPython-atomic) race.
+        self.counters = {"push_accepted": 0, "push_rejected": 0,
+                         "pull_dense": 0, "pull_embedding": 0}
 
     # -- RPCs ---------------------------------------------------------------
 
@@ -72,6 +77,7 @@ class PserverServicer:
         return pb.Empty()
 
     def pull_dense_parameters(self, request, _context=None):
+        self.counters["pull_dense"] += 1
         res = pb.PullDenseParametersResponse()
         # Serialize against in-place kernel updates so pulls never see a
         # half-applied parameter buffer.
@@ -98,6 +104,7 @@ class PserverServicer:
         # a second lock acquisition), which async SGD tolerates by
         # design — the same per-row semantics as the reference's Go
         # table (embedding_table.go:41-58 under RWMutex).
+        self.counters["pull_embedding"] += 1
         vectors = self._params.pull_embedding_vectors(
             request.name, np.asarray(request.ids, np.int64)
         )
@@ -120,6 +127,7 @@ class PserverServicer:
                 self._params.version += 1
                 version = self._params.version
                 self._post_update()
+                self.counters["push_accepted"] += 1
                 return pb.PushGradientsResponse(
                     accepted=True, version=version
                 )
@@ -127,11 +135,13 @@ class PserverServicer:
             if grad_version < (
                 self._params.version - self._sync_version_tolerance
             ):
+                self.counters["push_rejected"] += 1
                 return pb.PushGradientsResponse(
                     accepted=False, version=self._params.version
                 )
             self._grad_buffer.append((dense, embeddings))
             if len(self._grad_buffer) < self._grads_to_wait:
+                self.counters["push_accepted"] += 1
                 return pb.PushGradientsResponse(
                     accepted=True, version=self._params.version
                 )
@@ -141,6 +151,7 @@ class PserverServicer:
             self._params.version += 1
             version = self._params.version
             self._post_update()
+            self.counters["push_accepted"] += 1
             return pb.PushGradientsResponse(accepted=True, version=version)
 
     def prepare_gradients(self, request, _context=None):
@@ -162,6 +173,7 @@ class PserverServicer:
             if not self._use_async and grad_version < (
                 self._params.version - self._sync_version_tolerance
             ):
+                self.counters["push_rejected"] += 1
                 return pb.PushGradientsResponse(
                     accepted=False, version=self._params.version
                 )
@@ -184,6 +196,9 @@ class PserverServicer:
                 return pb.PushGradientsResponse(
                     accepted=False, version=self._params.version
                 )
+            # Counted at COMMIT, the point a 2PC push becomes real —
+            # prepare-stage rejects count as push_rejected above.
+            self.counters["push_accepted"] += 1
             dense, embeddings, lr_override, _ = staged
             if self._use_async:
                 self._apply(dense, embeddings, 1.0, lr_override)

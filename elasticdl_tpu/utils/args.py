@@ -125,6 +125,10 @@ def build_ps_parser():
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--evaluation_steps", type=int, default=0)
+    parser.add_argument("--status_port", type=int, default=-1,
+                        help="HTTP observability port (/healthz "
+                             "/status /metrics); 0 = any free port, "
+                             "-1 (default) = disabled")
     return parser
 
 

@@ -81,3 +81,41 @@ def test_prometheus_rendering_shapes():
     assert "elasticdl_workers_live 2" in text
     assert "elasticdl_rendezvous_world_size 2" in text
     assert 'elasticdl_worker_counter{name="batch_count"} 17' in text
+
+
+def test_ps_status_endpoint(tmp_path):
+    """The PS shard's observability twin: counters + version over the
+    shared HttpStatusServer."""
+    import numpy as np
+
+    from elasticdl_tpu.ps.server import ParameterServer
+    from elasticdl_tpu.utils.args import parse_ps_args
+    from elasticdl_tpu.utils import grpc_utils
+    from elasticdl_tpu.worker.ps_client import PSClient
+
+    ps = ParameterServer(parse_ps_args(
+        ["--port", "0", "--status_port", "0",
+         "--opt_args", "learning_rate=0.1"]))
+    ps.prepare()
+    try:
+        channel = grpc_utils.build_channel("localhost:%d" % ps.port)
+        grpc_utils.wait_for_channel_ready(channel)
+        client = PSClient([channel])
+        client.push_model({"w": np.ones(3, np.float32)})
+        client.push_gradients({"w": np.ones(3, np.float32)}, {},
+                              version=0)
+        client.pull_dense_parameters(-1)
+
+        code, body = _get(ps._status_server.port, "/status")
+        assert code == 200
+        status = json.loads(body)
+        assert status["version"] == 1
+        assert status["counters"]["push_accepted"] == 1
+        assert status["counters"]["pull_dense"] >= 1
+
+        code, text = _get(ps._status_server.port, "/metrics")
+        assert code == 200
+        assert "elasticdl_ps_version 1" in text
+        assert 'elasticdl_ps_requests{kind="push_accepted"} 1' in text
+    finally:
+        ps.stop()
