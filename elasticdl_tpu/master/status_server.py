@@ -44,15 +44,21 @@ def collect_status(task_manager, worker_manager=None,
     return status
 
 
+def prometheus_line(metric, value, **labels):
+    """One exposition-format sample line — THE renderer both the
+    master's and the PS's /metrics share."""
+    label_str = ""
+    if labels:
+        label_str = "{%s}" % ",".join(
+            '%s="%s"' % kv for kv in sorted(labels.items()))
+    return "%s%s %s" % (metric, label_str, value)
+
+
 def to_prometheus(status):
     lines = []
 
     def gauge(metric, value, **labels):
-        label_str = ""
-        if labels:
-            label_str = "{%s}" % ",".join(
-                '%s="%s"' % kv for kv in sorted(labels.items()))
-        lines.append("%s%s %s" % (metric, label_str, value))
+        lines.append(prometheus_line(metric, value, **labels))
 
     tasks = status["tasks"]
     gauge("elasticdl_tasks_todo", tasks["todo"])

@@ -866,3 +866,35 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
     )
     spec.config = cfg
     return spec
+
+
+def export_generate(export_dir, params, cfg, max_new_tokens,
+                    prompt_len, model_name="lm", **export_kwargs):
+    """Export GENERATION itself as a servable: the whole batched
+    prefill + KV-cache decode loop compiles into the StableHLO
+    artifact, so a plain servable host (``elasticdl-tpu serve``, or
+    anything that deserializes StableHLO) serves token generation over
+    ``:predict`` — prompt ids in, prompt+generated ids out — with no
+    model code, no generation loop, no LoRA code (pass merged params)
+    on the serving side.
+
+    Static shapes rule the export: ``prompt_len`` and
+    ``max_new_tokens`` are fixed per export (export several prompt
+    lengths side by side if clients vary); the BATCH stays polymorphic
+    like every servable.
+    """
+    from elasticdl_tpu.serving.export import export_servable
+
+    if prompt_len + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            "prompt_len %d + max_new_tokens %d exceeds max_seq_len %d"
+            % (prompt_len, max_new_tokens, cfg.max_seq_len))
+    return export_servable(
+        export_dir,
+        lambda p, prompt: generate(
+            p, cfg, prompt, max_new_tokens=max_new_tokens),
+        params,
+        np.zeros((1, prompt_len), np.int32),
+        model_name=model_name,
+        **export_kwargs,
+    )

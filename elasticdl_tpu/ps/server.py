@@ -90,6 +90,7 @@ class ParameterServer:
         if getattr(self.args, "status_port", -1) >= 0:
             from elasticdl_tpu.master.status_server import (
                 HttpStatusServer,
+                prometheus_line,
             )
 
             def collect():
@@ -103,12 +104,15 @@ class ParameterServer:
 
             def prom(status):
                 lines = [
-                    "elasticdl_ps_version %d" % status["version"],
-                    "elasticdl_ps_initialized %d"
-                    % int(status["initialized"]),
+                    prometheus_line("elasticdl_ps_version",
+                                    status["version"]),
+                    prometheus_line("elasticdl_ps_initialized",
+                                    int(status["initialized"])),
                 ] + [
-                    'elasticdl_ps_requests{kind="%s"} %d' % kv
-                    for kv in sorted(status["counters"].items())
+                    prometheus_line("elasticdl_ps_requests", count,
+                                    kind=kind)
+                    for kind, count in sorted(
+                        status["counters"].items())
                 ]
                 return "\n".join(lines) + "\n"
 

@@ -56,9 +56,10 @@ class PserverServicer:
         self._grad_buffer = []   # [(dense, embeddings)] awaiting sync apply
         self._staged = {}        # txn_id -> (dense, emb, lr, stage_time)
         self._staged_ttl = 60.0  # abandon prepares from dead workers
-        # Observability counters (ps/server.py --status_port): plain
-        # int bumps — pull_embedding is deliberately lock-free, so its
-        # counter tolerates the (benign, CPython-atomic) race.
+        # Observability counters (ps/server.py --status_port).  Bumps
+        # happen under self._lock EXCEPT pull_embedding, which is
+        # deliberately lock-free — that one counter tolerates rare
+        # lost increments rather than re-serializing the hot RPC.
         self.counters = {"push_accepted": 0, "push_rejected": 0,
                          "pull_dense": 0, "pull_embedding": 0}
 
@@ -77,11 +78,11 @@ class PserverServicer:
         return pb.Empty()
 
     def pull_dense_parameters(self, request, _context=None):
-        self.counters["pull_dense"] += 1
         res = pb.PullDenseParametersResponse()
         # Serialize against in-place kernel updates so pulls never see a
         # half-applied parameter buffer.
         with self._lock:
+            self.counters["pull_dense"] += 1
             res.initialized = self._params.initialized
             res.version = self._params.version
             if self._params.initialized and (
