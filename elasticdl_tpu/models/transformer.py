@@ -869,7 +869,8 @@ def model_spec(vocab_size=32000, dim=512, num_heads=8, num_layers=4,
 
 
 def export_generate(export_dir, params, cfg, max_new_tokens,
-                    prompt_len, model_name="lm", **export_kwargs):
+                    prompt_len, model_name="lm", temperature=0.0,
+                    **export_kwargs):
     """Export GENERATION itself as a servable: the whole batched
     prefill + KV-cache decode loop compiles into the StableHLO
     artifact, so a plain servable host (``elasticdl-tpu serve``, or
@@ -882,6 +883,12 @@ def export_generate(export_dir, params, cfg, max_new_tokens,
     ``max_new_tokens`` are fixed per export (export several prompt
     lengths side by side if clients vary); the BATCH stays polymorphic
     like every servable.
+
+    ``temperature`` > 0 exports a SAMPLING servable: the input becomes
+    the dict {"prompt": [B, Tp] int32, "seed": [] int32} — the
+    per-request seed folds into the PRNG key inside the artifact, so
+    repeated requests with different seeds draw different
+    continuations and equal seeds reproduce exactly.
     """
     from elasticdl_tpu.serving.export import export_servable
 
@@ -889,12 +896,31 @@ def export_generate(export_dir, params, cfg, max_new_tokens,
         raise ValueError(
             "prompt_len %d + max_new_tokens %d exceeds max_seq_len %d"
             % (prompt_len, max_new_tokens, cfg.max_seq_len))
+    if temperature < 0:
+        # A typo'd sign would silently ship a GREEDY artifact here
+        # while generate() itself would sample an inverted
+        # distribution — reject instead of exporting either surprise.
+        raise ValueError("temperature must be >= 0, got %r"
+                         % (temperature,))
+    if temperature > 0:
+        def serve_fn(p, inputs):
+            rng = jax.random.PRNGKey(inputs["seed"].astype(jnp.uint32))
+            return generate(
+                p, cfg, inputs["prompt"],
+                max_new_tokens=max_new_tokens,
+                temperature=temperature, rng=rng)
+
+        example = {"prompt": np.zeros((1, prompt_len), np.int32),
+                   "seed": np.int32(0)}
+    else:
+        serve_fn = lambda p, prompt: generate(
+            p, cfg, prompt, max_new_tokens=max_new_tokens)
+        example = np.zeros((1, prompt_len), np.int32)
     return export_servable(
         export_dir,
-        lambda p, prompt: generate(
-            p, cfg, prompt, max_new_tokens=max_new_tokens),
+        serve_fn,
         params,
-        np.zeros((1, prompt_len), np.int32),
+        example,
         model_name=model_name,
         **export_kwargs,
     )

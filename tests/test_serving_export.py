@@ -565,3 +565,49 @@ def test_generate_servable_over_http(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_sampled_generate_servable(tmp_path):
+    """temperature > 0 exports a SAMPLING servable with a per-request
+    seed: equal seeds reproduce exactly, different seeds diverge, and
+    everything stays in-vocab past the prompt."""
+    import jax
+
+    from elasticdl_tpu.models import transformer as tfm
+    from elasticdl_tpu.serving.loader import load_servable
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=128, dim=32, num_heads=4, num_layers=2,
+        max_seq_len=32, dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tfm.export_generate(
+        str(tmp_path / "s"), params, cfg, max_new_tokens=6,
+        prompt_len=4, temperature=0.9, platforms=("cpu",))
+    model = load_servable(str(tmp_path / "s"))
+    prompt = np.arange(8, dtype=np.int32).reshape(2, 4)
+    one = np.asarray(model.predict(
+        {"prompt": prompt, "seed": np.int32(7)}))
+    same = np.asarray(model.predict(
+        {"prompt": prompt, "seed": np.int32(7)}))
+    other = np.asarray(model.predict(
+        {"prompt": prompt, "seed": np.int32(8)}))
+    np.testing.assert_array_equal(one, same)  # seed reproduces
+    assert not np.array_equal(one, other)     # seed matters
+    assert one.shape == (2, 10)
+    np.testing.assert_array_equal(one[:, :4], prompt)
+    assert ((one >= 0) & (one < 128)).all()
+
+
+def test_export_generate_rejects_negative_temperature(tmp_path):
+    import jax
+
+    from elasticdl_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, dim=16, num_heads=2,
+                                num_layers=1, max_seq_len=16,
+                                dtype="float32")
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="temperature"):
+        tfm.export_generate(str(tmp_path / "t"), params, cfg,
+                            max_new_tokens=4, prompt_len=4,
+                            temperature=-0.5)
