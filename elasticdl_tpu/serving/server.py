@@ -184,7 +184,30 @@ class ModelEndpoint:
         return {"vectors": vectors.tolist()}
 
 
-def build_server(endpoint, port=0, host="127.0.0.1"):
+def build_server(endpoints, port=0, host="127.0.0.1"):
+    """``endpoints``: one ModelEndpoint or a list — the TF-Serving
+    model-config role: one server process hosts several models, each
+    under its own /v1/models/<name> tree."""
+    if isinstance(endpoints, ModelEndpoint):
+        endpoints = [endpoints]
+    by_name = {e.name: e for e in endpoints}
+    if len(by_name) != len(endpoints):
+        raise ValueError(
+            "duplicate model names: %s"
+            % sorted(e.name for e in endpoints))
+
+    # Routing tables built ONCE: O(1) dispatch per request.
+    get_paths = {}
+    post_routes = {}
+    for name, endpoint in by_name.items():
+        base = "/v1/models/%s" % name
+        # TF Serving clients also GET <base>/metadata; serve the
+        # alias so their request shape carries over.
+        get_paths[base] = endpoint.metadata
+        get_paths[base + "/metadata"] = endpoint.metadata
+        post_routes[base + ":predict"] = endpoint.predict
+        post_routes[base + ":lookup"] = endpoint.lookup
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("http: " + fmt, *args)
@@ -198,13 +221,11 @@ def build_server(endpoint, port=0, host="127.0.0.1"):
             self.wfile.write(body)
 
         def do_GET(self):
-            base = "/v1/models/%s" % endpoint.name
-            # TF Serving clients also GET <base>/metadata; serve it as
-            # an alias so their request shape really does carry over.
-            if self.path in (base, base + "/metadata"):
-                self._reply(200, endpoint.metadata())
-            else:
-                self._reply(404, {"error": "unknown path %r" % self.path})
+            handler = get_paths.get(self.path)
+            if handler is not None:
+                return self._reply(200, handler())
+            self._reply(404, {"error": "unknown path %r (models: %s)"
+                              % (self.path, sorted(by_name))})
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
@@ -214,15 +235,11 @@ def build_server(endpoint, port=0, host="127.0.0.1"):
                 body = json.loads(self.rfile.read(length) or b"{}")
             except ValueError as e:
                 return self._reply(400, {"error": "bad JSON: %s" % e})
-            route = {
-                "/v1/models/%s:predict" % endpoint.name:
-                    endpoint.predict,
-                "/v1/models/%s:lookup" % endpoint.name:
-                    endpoint.lookup,
-            }.get(self.path)
+            route = post_routes.get(self.path)
             if route is None:
                 return self._reply(
-                    404, {"error": "unknown path %r" % self.path})
+                    404, {"error": "unknown path %r (models: %s)"
+                          % (self.path, sorted(by_name))})
             try:
                 self._reply(200, route(body))
             except (KeyError, ValueError, TypeError) as e:
@@ -239,7 +256,10 @@ def build_server(endpoint, port=0, host="127.0.0.1"):
 
 def main(argv=None):
     parser = argparse.ArgumentParser("elasticdl-tpu model server")
-    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--export_dir", required=True,
+                        help="one export dir, or several as "
+                             "name1=dir1,name2=dir2 (the TF-Serving "
+                             "model-config role)")
     parser.add_argument("--model_name", default=None)
     parser.add_argument("--port", type=int, default=8501)
     parser.add_argument("--host", default="0.0.0.0")
@@ -253,13 +273,34 @@ def main(argv=None):
         jax.config.update(
             "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
         )
-    endpoint = ModelEndpoint(args.export_dir, name=args.model_name)
-    server = build_server(endpoint, port=args.port, host=args.host)
+    # Multi-model form: EVERY comma-piece must be name=dir (a single
+    # path that merely CONTAINS '=' is not a spec list).
+    pieces = [p.strip() for p in args.export_dir.split(",")
+              if p.strip()]
+    is_multi = len(pieces) > 0 and all(
+        "=" in p and p.partition("=")[0].strip()
+        and p.partition("=")[2].strip() for p in pieces
+    ) and ("=" in args.export_dir)
+    if is_multi and (len(pieces) > 1 or os.path.sep not in
+                     pieces[0].partition("=")[0]):
+        if args.model_name:
+            logger.warning(
+                "--model_name %r ignored: the name=dir form names "
+                "each model explicitly", args.model_name)
+        endpoints = [
+            ModelEndpoint(p.partition("=")[2].strip(),
+                          name=p.partition("=")[0].strip())
+            for p in pieces
+        ]
+    else:
+        endpoints = [ModelEndpoint(args.export_dir,
+                                   name=args.model_name)]
+    server = build_server(endpoints, port=args.port, host=args.host)
     logger.info(
-        "serving model %r on %s:%d (predict: POST "
-        "/v1/models/%s:predict)",
-        endpoint.name, args.host, server.server_address[1],
-        endpoint.name,
+        "serving model(s) %s on %s:%d (predict: POST "
+        "/v1/models/<name>:predict)",
+        sorted(e.name for e in endpoints), args.host,
+        server.server_address[1],
     )
     try:
         server.serve_forever()

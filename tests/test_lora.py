@@ -155,3 +155,18 @@ def test_adapt_from_quantized_base_export(tmp_path):
         np.testing.assert_allclose(
             got[name], want[name], rtol=0.02, atol=0.02,
             err_msg="%s not dequantized-loaded" % name)
+
+
+def test_lora_under_parallel_mesh():
+    """Merge-at-forward must compose with the tp/sp-sharded mesh path
+    (adapters are replicated; the delta add follows W's sharding)."""
+    from elasticdl_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(tp=2, sp=2)  # 8 virtual devices -> dp=2
+    spec = lora.model_spec(rank=2, mesh=mesh, **LM_KW)
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    toks = make_tokens(2, 16, seed=12)
+    out = np.asarray(spec.apply_fn(params, toks, False))
+    want = np.asarray(
+        tfm.forward(params["base"], toks, spec.config, mesh=mesh))
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)

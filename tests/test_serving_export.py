@@ -611,3 +611,47 @@ def test_export_generate_rejects_negative_temperature(tmp_path):
         tfm.export_generate(str(tmp_path / "t"), params, cfg,
                             max_new_tokens=4, prompt_len=4,
                             temperature=-0.5)
+
+
+def test_multi_model_server(tmp_path):
+    """One server process hosts several models (the TF-Serving
+    model-config role): each under its own /v1/models/<name> tree,
+    unknown names 404 listing the hosted set."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.server import ModelEndpoint, build_server
+
+    for name, scale in (("a", 2.0), ("b", 5.0)):
+        export_servable(
+            str(tmp_path / name), lambda p, x: x * p["s"],
+            {"s": np.float32(scale)}, np.zeros((1, 2), np.float32),
+            model_name=name, platforms=("cpu",))
+    server = build_server(
+        [ModelEndpoint(str(tmp_path / "a")),
+         ModelEndpoint(str(tmp_path / "b"))], port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def predict(name, x):
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/%s:predict" % (port, name),
+            data=_json.dumps({"instances": x}).encode())
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return _json.loads(resp.read())["predictions"]
+
+    try:
+        np.testing.assert_allclose(predict("a", [[1, 2]]), [[2., 4.]])
+        np.testing.assert_allclose(predict("b", [[1, 2]]), [[5., 10.]])
+        with pytest.raises(urllib.error.HTTPError) as err:
+            predict("c", [[1, 2]])
+        assert err.value.code == 404
+        with pytest.raises(ValueError, match="duplicate"):
+            build_server([ModelEndpoint(str(tmp_path / "a")),
+                          ModelEndpoint(str(tmp_path / "a"))], port=0)
+    finally:
+        server.shutdown()
+        server.server_close()
