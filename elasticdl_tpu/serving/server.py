@@ -221,6 +221,10 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/healthz":
+                # liveness/readiness probe target (matches the
+                # master's and PS's observability surface)
+                return self._reply(200, {"status": "ok"})
             handler = get_paths.get(self.path)
             if handler is not None:
                 return self._reply(200, handler())

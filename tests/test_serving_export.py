@@ -644,6 +644,10 @@ def test_multi_model_server(tmp_path):
             return _json.loads(resp.read())["predictions"]
 
     try:
+        with urllib.request.urlopen(
+            "http://127.0.0.1:%d/healthz" % port, timeout=10
+        ) as resp:
+            assert _json.loads(resp.read()) == {"status": "ok"}
         np.testing.assert_allclose(predict("a", [[1, 2]]), [[2., 4.]])
         np.testing.assert_allclose(predict("b", [[1, 2]]), [[5., 10.]])
         with pytest.raises(urllib.error.HTTPError) as err:
