@@ -659,3 +659,73 @@ def test_multi_model_server(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_hot_reload_under_concurrent_load(tmp_path):
+    """Hammer :predict from N threads while new versions export
+    concurrently: every response must be valid and correspond to SOME
+    exported version (the atomic (model, dtypes) swap under the reload
+    lock must never produce a torn or failed response)."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.serving.server import ModelEndpoint, build_server
+
+    base = str(tmp_path / "m")
+    scales = {v: float(v) for v in range(1, 6)}
+
+    def put(version):
+        export_servable(
+            os.path.join(base, str(version)),
+            lambda p, x: x * p["s"],
+            {"s": np.float32(scales[version])},
+            np.zeros((1, 2), np.float32),
+            model_name="hot", version=version, platforms=("cpu",))
+
+    put(1)
+    server = build_server(
+        ModelEndpoint(base, poll_interval=0.01), port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d/v1/models/hot:predict" % port
+    stop = threading.Event()
+    failures = []
+    seen_scales = set()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    url, data=_json.dumps(
+                        {"instances": [[1.0, 1.0]]}).encode())
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    out = _json.loads(resp.read())["predictions"]
+                scale = out[0][0]
+                if out[0] != [scale, scale] or (
+                    scale not in scales.values()
+                ):
+                    failures.append(out)
+                seen_scales.add(scale)
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for version in range(2, 6):
+            put(version)
+            import time as _time
+
+            _time.sleep(0.3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        server.shutdown()
+        server.server_close()
+    assert not failures, failures[:5]
+    assert 5.0 in seen_scales  # the last version was eventually served
+    assert len(seen_scales) >= 2  # at least one live flip observed
