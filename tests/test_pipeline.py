@@ -142,14 +142,19 @@ def test_pipeline_with_dp_axis():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_transformer_pipelined_matches_sequential():
+@pytest.mark.parametrize("variant", ["dense", "gqa+window"])
+def test_transformer_pipelined_matches_sequential(variant):
     """End-to-end: the flagship transformer's pipelined forward (pp=2,
-    dp=2) reproduces the plain scanned forward's loss and gradients."""
+    dp=2) reproduces the plain scanned forward's loss and gradients —
+    incl. the GQA + sliding-window attention variants riding through
+    the pipeline unchanged."""
     from elasticdl_tpu.models import transformer as tfm
 
     cfg = tfm.TransformerConfig(
         vocab_size=128, dim=32, num_heads=4, num_layers=4,
         max_seq_len=16, dtype="float32",
+        **({"num_kv_heads": 2, "window": 8}
+           if variant == "gqa+window" else {}),
     )
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     tokens = jnp.asarray(
