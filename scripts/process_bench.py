@@ -1,0 +1,115 @@
+"""Turn bench_results/*.json captures into BENCHMARKS.md-ready rows.
+
+Run after scripts/tpu_window.sh: prints a markdown summary of the
+NEWEST capture per stage (older captures are listed by name so none
+disappear silently) — headline numbers, the A/B matrix as a table,
+per-leg elastic recovery, recorded failure reasons, and the
+provenance (device fingerprint, sample spread) a reviewer needs —
+paste into BENCHMARKS.md and flip defaults the data supports.
+
+Usage: python scripts/process_bench.py [bench_results_dir]
+"""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from elasticdl_tpu.utils.jsonline import last_json_line  # noqa: E402
+
+
+def _load(path):
+    with open(path) as f:
+        return last_json_line(f.read())
+
+
+def _one_line(text):
+    """Markdown-table-safe cell: collapse newlines, escape pipes."""
+    return " ".join(str(text).split()).replace("|", "\\|")
+
+
+def _spread(samples):
+    blocks = (samples or {}).get("blocks") or []
+    per_iter = [ms / iters for iters, ms in blocks if iters]
+    if not per_iter:
+        return "n/a"
+    return "%.1f-%.1f ms/iter over %d blocks" % (
+        min(per_iter), max(per_iter), len(per_iter))
+
+
+def summarize(results_dir):
+    lines = []
+    for stage, pattern in (("headline", "headline_*.json"),
+                           ("kernels", "kernels_*.json"),
+                           ("elastic", "elastic_*.json")):
+        paths = sorted(glob.glob(os.path.join(results_dir, pattern)))
+        if not paths:
+            lines.append("## %s: no captures" % stage)
+            continue
+        data = _load(paths[-1])
+        lines.append("## %s (%s)" % (stage,
+                                     os.path.basename(paths[-1])))
+        if len(paths) > 1:
+            lines.append("  (older captures not shown: %s)" % ", ".join(
+                os.path.basename(p) for p in paths[:-1]))
+        if data is None:
+            lines.append("  unparseable")
+            continue
+        top_error = data.get("error") or data.get(
+            "detail", {}).get("error") if isinstance(
+            data.get("detail", {}), dict) else data.get("error")
+        if top_error:
+            lines.append("- **FAILED**: %s" % _one_line(top_error))
+            lines.append("")
+            continue
+        if stage == "headline":
+            det = data.get("detail", {})
+            lines.append(
+                "- **%s %s** (vs_baseline %s, platform %s)" % (
+                    data.get("value"), data.get("unit"),
+                    data.get("vs_baseline"),
+                    det.get("platform")))
+            lines.append("- device: %s" % det.get("device"))
+            lines.append("- samples: %s" % _spread(det.get("samples")))
+            if det.get("mfu_estimate") is not None:
+                lines.append("- MFU estimate: %.1f%%"
+                             % (100 * det["mfu_estimate"]))
+        elif stage == "kernels":
+            rows = data.get("rows", {})
+            if rows.get("device"):
+                lines.append("- device: %s" % rows["device"])
+            for section in ("resnet", "lm", "decode"):
+                table = rows.get(section) or {}
+                if not table:
+                    continue
+                lines.append("\n### %s" % section)
+                lines.append("| config | result |")
+                lines.append("|---|---|")
+                for name, row in table.items():
+                    if "error" in row:
+                        cell = "ERROR: %s" % _one_line(row["error"])
+                    else:
+                        keep = {k: v for k, v in row.items()
+                                if k != "samples"}
+                        keep["samples"] = _spread(row.get("samples"))
+                        cell = ", ".join(
+                            "%s=%s" % kv for kv in keep.items())
+                    lines.append("| %s | %s |" % (name, cell))
+        else:
+            legs = data.get("detail", {}).get("platform_legs", {})
+            lines.append("- headline: %s s (leg %s)" % (
+                data.get("value"),
+                data.get("detail", {}).get("headline_leg")))
+            for leg, row in legs.items():
+                lines.append("- %s: %s" % (leg, row))
+        lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    results_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results")
+    print(summarize(results_dir))
