@@ -170,3 +170,20 @@ def test_lora_under_parallel_mesh():
     want = np.asarray(
         tfm.forward(params["base"], toks, spec.config, mesh=mesh))
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_lora_with_chunked_xent_matches_dense_loss():
+    """LoRA + chunked cross-entropy (the realistic large-model
+    fine-tune config): the chunked loss path hands MERGED params to
+    the head matmul inside loss_fn, so chunked == dense loss under
+    adapters."""
+    from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+    toks = make_tokens(4, 16, seed=20)
+    losses = {}
+    for chunk in (0, 8):
+        spec = lora.model_spec(rank=2, xent_chunk=chunk, **LM_KW)
+        trainer = CollectiveTrainer(spec, batch_size=4)
+        loss, _ = trainer.train_minibatch(toks, toks)
+        losses[chunk] = float(loss)
+    assert abs(losses[0] - losses[8]) < 1e-5, losses
