@@ -11,6 +11,7 @@ import dataclasses
 
 import jax
 import numpy as np
+import pytest
 
 from elasticdl_tpu.models import lora, transformer as tfm
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
@@ -187,3 +188,19 @@ def test_lora_with_chunked_xent_matches_dense_loss():
         loss, _ = trainer.train_minibatch(toks, toks)
         losses[chunk] = float(loss)
     assert abs(losses[0] - losses[8]) < 1e-5, losses
+
+
+def test_lora_on_moe_config():
+    """MoE base: the default attention targets adapt fine (zero-delta
+    == base), and targeting a 4-D expert matrix fails with the
+    rank-explaining error rather than a shape surprise."""
+    spec = lora.model_spec(rank=2, moe_experts=2, **LM_KW)
+    params = spec.init_fn(jax.random.PRNGKey(0))
+    toks = make_tokens(2, 8, seed=30)
+    got = np.asarray(spec.apply_fn(params, toks, False))
+    want = np.asarray(tfm.forward(params["base"], toks, spec.config))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    bad = lora.model_spec(rank=2, moe_experts=2,
+                          lora_targets="wq,w_gate", **LM_KW)
+    with pytest.raises(ValueError, match="rank-4"):
+        bad.init_fn(jax.random.PRNGKey(0))  # raised at adapter init
