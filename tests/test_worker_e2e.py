@@ -308,3 +308,39 @@ def test_graceful_preemption_checkpoints_before_exit(tmp_path):
     assert os.path.isdir(ckpt) and any(
         name.startswith("version-") for name in os.listdir(ckpt)
     ), os.listdir(ckpt) if os.path.isdir(ckpt) else "no ckpt dir"
+
+
+@pytest.mark.slow
+def test_managed_collective_lora_finetune():
+    """Elastic fine-tuning: the LoRA zoo entry under a managed
+    2-worker collective world — multi_transform masking, the
+    {base, lora} param tree, and snapshot_to_host all ride the
+    cross-process global-batch path; zero lost tasks."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTICDL_TPU_PLATFORM"] = "cpu"
+    env["ELASTICDL_COLLECTIVE_HEARTBEAT"] = "5"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "elasticdl_tpu.master.main",
+            "--model_zoo", "lora",
+            "--model_params",
+            "rank=4;vocab_size=128;dim=32;num_heads=4;num_layers=2;"
+            "seq_len=16;dtype=float32",
+            "--data_origin", "synthetic_lm:512:16:128",
+            "--batch_size", "8", "--num_workers", "2",
+            "--num_minibatches_per_task", "4",
+            "--distribution_strategy", "collective",
+        ],
+        capture_output=True, text=True, env=env, timeout=420,
+    )
+    text = proc.stdout + proc.stderr
+    assert proc.returncode == 0, text[-4000:]
+    assert "job finished" in text
+    assert "'failed': {0: 0" in text, text[-2000:]
+    assert "collective world joined (client-only): rank 0 / 2" in text
+    assert "LoRA r=4" in text
