@@ -1,0 +1,23 @@
+#!/bin/bash
+# Tail-of-session watcher: stop probing by 21:10 UTC so nothing
+# contends with the driver's end-of-round bench run; on a healthy
+# probe, fire the full bench window.
+LOG=/root/repo/.relay_probe.log
+cd /root/repo
+while [ "$(date -u +%H%M)" -lt 2110 ]; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 150 python -c "
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((128,128)); v = float((x@x).sum())
+print('PROBE-OK', d[0].platform, v, flush=True)
+" 2>&1 | grep "PROBE-OK" | head -1)
+  echo "$ts tailprobe out=[$out]" >> "$LOG"
+  if [ -n "$out" ]; then
+    echo "RELAY HEALTHY at $ts: $out" >> "$LOG"
+    bash scripts/tpu_window.sh >> "$LOG" 2>&1
+    exit 0
+  fi
+  sleep 120
+done
