@@ -150,6 +150,19 @@ class ElasticCollectiveController:
         self._steps_since_check = 0
         self._last_check = now
         changed = self._rendezvous.poll(wait=not self._first_init_done)
+        if self._rendezvous.rank < 0:
+            # Mid-churn the committed world can exclude this host
+            # (poll(wait=False) still reports the new epoch).  Never
+            # build a coordination client with process_id=-1 — and
+            # never stay attached to the PREVIOUS epoch either: the
+            # master reaps its service after reap_secs, which kills an
+            # attached client from C++.  Detach to single-process mode
+            # and re-announce LOOP_START so the next commit re-admits
+            # us (epoch bumps again -> rank >= 0 -> rebuild).
+            if changed:
+                self.leave_world()
+                self._mc.report_train_loop_status(pb.LOOP_START)
+            return False
         if changed or not self._first_init_done:
             self._reinit_world()
             self._first_init_done = True
@@ -205,12 +218,29 @@ class ElasticCollectiveController:
         Horovod survivors wait on a new rendezvous).  Returns True if
         a new epoch arrived."""
         deadline = time.time() + timeout
+        epoch_seen = False
+        announced = False
         while time.time() < deadline:
             if self._rendezvous.poll(wait=False):
+                epoch_seen = True
+            # Guard on rank >= 0 (ADVICE r5 low): a new epoch can
+            # commit WITHOUT this host (the master batches joins behind
+            # a grace window), and _reinit_world with rank=-1 would
+            # build a coordination client with process_id=-1 —
+            # undefined/fatal.  Keep polling until we are a member of
+            # some committed epoch.
+            if epoch_seen and self._rendezvous.rank >= 0:
                 self._reinit_world()
                 self._last_check = time.time()
                 self._steps_since_check = 0
                 return True
+            if epoch_seen and not announced:
+                # Excluded from the new world: detach from the doomed
+                # old epoch (its service gets reaped) and re-announce
+                # so the master's next commit re-admits us.
+                self.leave_world()
+                self._mc.report_train_loop_status(pb.LOOP_START)
+                announced = True
             time.sleep(poll_secs)
         return False
 

@@ -106,6 +106,17 @@ class TokenFileDataReader(AbstractDataReader):
         # --shuffle silently no-ops and resume diverges.
         indices = task.shard.record_indices or range(
             task.shard.start, task.shard.end)
+        n_tokens = len(self._mmap)
         for idx in indices:
+            # Fail loudly on a truncated file or stale shard range: a
+            # silent short slice would break the static [B, T] batch
+            # shape downstream (ADVICE r5 low).
+            if idx < 0 or (idx + 1) * T > n_tokens:
+                raise ValueError(
+                    "token shard window %d of %s is out of range: "
+                    "[%d:%d) exceeds the file's %d tokens — truncated "
+                    "file or stale shard metadata?"
+                    % (idx, self._path, idx * T, (idx + 1) * T,
+                       n_tokens))
             window = self._mmap[idx * T:(idx + 1) * T]
             yield (np.asarray(window, dtype=np.int32),)

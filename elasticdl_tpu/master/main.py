@@ -135,6 +135,7 @@ def build_master(args):
     if args.distribution_strategy == "collective":
         from elasticdl_tpu.parallel.distributed import (
             MasterCoordinationService,
+            derive_reap_secs,
         )
 
         # The master hosts the per-epoch JAX coordination service so
@@ -160,7 +161,14 @@ def build_master(args):
             coord_host = "localhost"
         rendezvous = RendezvousServer(
             coordinator_factory=MasterCoordinationService(
-                host=coord_host
+                host=coord_host,
+                # Old-epoch services must outlive the workers'
+                # worst-case epoch discovery: workers poll every
+                # num_minibatches_per_task steps (worker/main.py
+                # passes the same value as check_steps).
+                reap_secs=derive_reap_secs(
+                    check_steps=max(1, args.num_minibatches_per_task)
+                ),
             ).start_epoch,
         )
     ps_manager = None

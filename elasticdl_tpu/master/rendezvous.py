@@ -38,7 +38,8 @@ class RendezvousServer:
         self._coordinator_addr = ""
 
     def set_coordinator_addr(self, addr):
-        self._coordinator_addr = addr
+        with self._lock:
+            self._coordinator_addr = addr
 
     @property
     def rendezvous_id(self):
@@ -64,8 +65,7 @@ class RendezvousServer:
                 self._last_change = time.time()
                 logger.info("rendezvous: worker %s leaving", host)
 
-    def _maybe_commit(self):
-        # caller holds the lock
+    def _maybe_commit_locked(self):
         if (
             self._next_hosts != self._cur_hosts
             and self._last_change is not None
@@ -105,7 +105,7 @@ class RendezvousServer:
         should keep polling.
         """
         with self._lock:
-            self._maybe_commit()
+            self._maybe_commit_locked()
             if host in self._cur_hosts:
                 rank = self._cur_hosts.index(host)
             else:

@@ -11,6 +11,7 @@ import threading
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.proto import rpc
 from elasticdl_tpu.utils import grpc_utils, tensor_codec
+from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.master.task_manager import wait_task_pb
 
@@ -42,6 +43,7 @@ class MasterServicer:
 
     # -- task dispatch ------------------------------------------------------
 
+    @rpc_error_guard
     def get_task(self, request, _context=None):
         res = pb.GetTaskResponse()
         task = self._task_manager.get(request.worker_id)
@@ -57,6 +59,7 @@ class MasterServicer:
             res.task.type = pb.TRAINING  # no more work: worker exits
         return res
 
+    @rpc_error_guard
     def report_task_result(self, request, _context=None):
         success = not request.err_message
         if request.exec_counters:
@@ -82,6 +85,7 @@ class MasterServicer:
             self._evaluation_service.complete_task()
         return pb.Empty()
 
+    @rpc_error_guard
     def report_batch_done(self, request, _context=None):
         with self._lock:
             prev = self.worker_record_counts.get(request.worker_id, 0)
@@ -92,6 +96,7 @@ class MasterServicer:
 
     # -- rendezvous ---------------------------------------------------------
 
+    @rpc_error_guard
     def get_comm_rank(self, request, _context=None):
         res = pb.GetCommRankResponse()
         if self._rendezvous is None:
@@ -106,6 +111,7 @@ class MasterServicer:
         res.coordinator_addr = coord
         return res
 
+    @rpc_error_guard
     def report_train_loop_status(self, request, _context=None):
         if self._rendezvous is not None:
             if request.status == pb.LOOP_START:
@@ -116,6 +122,7 @@ class MasterServicer:
 
     # -- evaluation / versions ---------------------------------------------
 
+    @rpc_error_guard
     def report_evaluation_metrics(self, request, _context=None):
         if self._evaluation_service is not None:
             outputs = {
@@ -130,6 +137,7 @@ class MasterServicer:
             )
         return pb.Empty()
 
+    @rpc_error_guard
     def report_version(self, request, _context=None):
         with self._lock:
             self._version = max(self._version, request.model_version)
@@ -139,6 +147,7 @@ class MasterServicer:
             )
         return pb.Empty()
 
+    @rpc_error_guard
     def report_training_params(self, request, _context=None):
         self.training_params = request
         return pb.Empty()

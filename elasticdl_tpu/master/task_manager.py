@@ -122,9 +122,9 @@ class TaskManager:
                 "TaskManager: %d training shards, %d epochs",
                 len(self._training_shards), num_epochs,
             )
-            self._create_training_tasks()
+            self._create_training_tasks_locked()
         elif self._prediction_shards:
-            self._create_tasks(self._prediction_shards, pb.PREDICTION)
+            self._create_tasks_locked(self._prediction_shards, pb.PREDICTION)
 
     # -- task creation ------------------------------------------------------
 
@@ -142,7 +142,7 @@ class TaskManager:
                 pos = chunk_end
         return out
 
-    def _create_tasks(self, shards, task_type, model_version=-1):
+    def _create_tasks_locked(self, shards, task_type, model_version=-1):
         pieces = self._split(shards)
         if task_type == pb.TRAINING and self._shuffle_shards:
             self._rng.shuffle(pieces)
@@ -158,8 +158,8 @@ class TaskManager:
         self._todo.extend(tasks)
         return tasks
 
-    def _create_training_tasks(self):
-        self._create_tasks(self._training_shards, pb.TRAINING)
+    def _create_training_tasks_locked(self):
+        self._create_tasks_locked(self._training_shards, pb.TRAINING)
 
     def skip_records(self, num_records):
         """Drop already-trained records after a checkpoint resume
@@ -190,7 +190,7 @@ class TaskManager:
     def create_evaluation_tasks(self, model_version):
         """Version-triggered eval job (reference task_manager create_evaluation_tasks)."""
         with self._lock:
-            tasks = self._create_tasks(
+            tasks = self._create_tasks_locked(
                 self._evaluation_shards, pb.EVALUATION, model_version
             )
             # Evaluation interleaves ahead of remaining training tasks.
@@ -199,7 +199,8 @@ class TaskManager:
             return len(tasks)
 
     def set_train_end_callback_task(self):
-        self._train_end_callback_pending = True
+        with self._lock:
+            self._train_end_callback_pending = True
 
     # -- dispatch -----------------------------------------------------------
 
@@ -210,7 +211,7 @@ class TaskManager:
                 if self._epoch < self._num_epochs - 1 and self._training_shards:
                     self._epoch += 1
                     logger.info("starting epoch %d", self._epoch)
-                    self._create_training_tasks()
+                    self._create_training_tasks_locked()
                 elif (
                     self._train_end_callback_pending
                     and not self._train_end_callback_done
@@ -336,7 +337,9 @@ class TaskManager:
         self._stopped.set()
 
     def _timeout_threshold(self):
-        return max(self._task_timeout_secs, 3 * self._max_task_completed_time)
+        with self._lock:
+            longest = self._max_task_completed_time
+        return max(self._task_timeout_secs, 3 * longest)
 
     def _watch_timeouts(self):
         while not self._stopped.wait(timeout=5):

@@ -104,7 +104,8 @@ class WorkerManager:
     # -- wiring -------------------------------------------------------------
 
     def set_master_addr(self, addr):
-        self._master_addr = addr
+        with self._lock:
+            self._master_addr = addr
 
     def add_exit_callback(self, fn):
         self._exit_callbacks.append(fn)

@@ -53,27 +53,64 @@ def _heartbeat_secs():
     return int(os.environ.get("ELASTICDL_COLLECTIVE_HEARTBEAT", "10"))
 
 
+# Mirrors api/controller.py DEFAULT_SECS_TO_CHECK_RENDEZVOUS (not
+# imported: this module must stay importable without the api package).
+_DEFAULT_CHECK_SECS = 20.0
+
+
+def derive_reap_secs(check_steps=None, check_secs=None,
+                     step_secs_bound=None, margin=None):
+    """Old-epoch service lifetime derived from the workers' actual
+    epoch-discovery cadence (ADVICE r5 medium).
+
+    A survivor only notices a new epoch when its controller polls the
+    rendezvous — every ``check_steps`` steps (bounded by
+    ``step_secs_bound`` seconds per step, env
+    ``ELASTICDL_STEP_SECS_BOUND``) or every ``check_secs`` seconds —
+    and must then detach from the OLD epoch's service with an explicit
+    shutdown RPC that is only safe while that service is still up
+    (MasterCoordinationService docstring).  A fixed reap delay shorter
+    than the discovery latency therefore terminates survivors
+    uncatchably; one derived from the cadence plus a heartbeat-sized
+    margin cannot."""
+    if step_secs_bound is None:
+        step_secs_bound = float(os.environ.get(
+            "ELASTICDL_STEP_SECS_BOUND", "5.0"))
+    if margin is None:
+        margin = 2.0 * _heartbeat_secs()
+    cadence = 0.0
+    if check_steps:
+        cadence = max(cadence, check_steps * step_secs_bound)
+    if check_secs:
+        cadence = max(cadence, float(check_secs))
+    if not cadence:
+        cadence = _DEFAULT_CHECK_SECS
+    return cadence + margin
+
+
 class MasterCoordinationService:
     """Master-side JAX coordination service, one instance per epoch.
 
     ``start_epoch(world_size)`` starts a fresh service on a free port
     and returns its advertised ``jaxsvc://host:port`` address.  The
-    PREVIOUS epoch's service is reaped on a timer after ``reap_secs``
-    (default 30): survivors of a membership change must detach from it
-    with an explicit client shutdown, and that RPC is only safe while
-    the old service is still up — the client's heartbeat/shutdown
-    failure paths TERMINATE the worker process from C++ (and this
-    jaxlib's missed_heartbeat_callback binding raises std::bad_cast
-    for every Python callable, so the fatal path cannot be
-    intercepted).  ``reap_secs`` therefore must exceed the workers'
-    worst-case epoch-discovery time (their rendezvous check cadence
-    plus one step)."""
+    PREVIOUS epoch's service is reaped on a timer after ``reap_secs``:
+    survivors of a membership change must detach from it with an
+    explicit client shutdown, and that RPC is only safe while the old
+    service is still up — the client's heartbeat/shutdown failure
+    paths TERMINATE the worker process from C++ (and this jaxlib's
+    missed_heartbeat_callback binding raises std::bad_cast for every
+    Python callable, so the fatal path cannot be intercepted).
+    ``reap_secs`` therefore must exceed the workers' worst-case
+    epoch-discovery time; ``reap_secs=None`` derives it from the check
+    cadence via ``derive_reap_secs`` (pass the job's actual
+    ``check_steps`` there, as master/main.py does)."""
 
     def __init__(self, host="localhost", shutdown_timeout=3,
-                 reap_secs=30.0):
+                 reap_secs=None):
         self._host = host
         self._shutdown_timeout = shutdown_timeout
-        self._reap_secs = reap_secs
+        self._reap_secs = (derive_reap_secs() if reap_secs is None
+                           else reap_secs)
         self._service = None
         self._reapers = []
 
@@ -163,12 +200,14 @@ def _client_connect(rank, world_size, host_port):
         # A peer dying must surface as a catchable collective error in
         # the survivors, not terminate them from the error-poll thread.
         recoverable=True,
-        # Disconnect is DROP-only (below): a ShutdownTask RPC against
-        # an epoch whose service the master already replaced LOG(FATAL)s
-        # in the client — never send it, never let a destructor send it.
-        # (The default missed-heartbeat handler also terminates, but the
-        # drop-only disconnect frees the old client well inside the
-        # heartbeat window, so it never fires on a dead epoch.)
+        # The DESTRUCTOR must never send ShutdownTask: GC can run
+        # after the master reaped the old epoch's service, and a
+        # shutdown RPC against a dead service LOG(FATAL)s in the
+        # client.  The explicit _client_disconnect below DOES send it
+        # deliberately — at a controlled point inside the master's
+        # reap window, while the old service is guaranteed alive
+        # (reap_secs is derived from the epoch-discovery cadence,
+        # derive_reap_secs).
         shutdown_on_destruction=False,
     )
     state.client.connect()

@@ -28,7 +28,13 @@ class Parameters:
         self.embeddings = {}        # name -> NativeEmbeddingTable
         self.embedding_infos = {}   # name -> info dict
         self.slot_tables = {}       # slot table name -> NativeEmbeddingTable
-        self._lock = threading.Lock()
+        # RLock: init_from_model_pb holds it across set_embedding_infos.
+        # This lock makes Parameters internally consistent (init,
+        # restore, checkpoint payload, table registry); per-row
+        # embedding traffic stays on the native tables' rw-lock, and
+        # gradient APPLIES are serialized one level up by the
+        # servicer's lock (ps/servicer.py).
+        self._lock = threading.RLock()
 
     # -- init ---------------------------------------------------------------
 
@@ -55,6 +61,10 @@ class Parameters:
             return True
 
     def set_embedding_infos(self, infos):
+        with self._lock:
+            self._set_embedding_infos_locked(infos)
+
+    def _set_embedding_infos_locked(self, infos):
         for info in infos:
             name = info["name"]
             if name in self.embeddings:
@@ -77,54 +87,70 @@ class Parameters:
     def create_slot_tables(self, slot_names):
         """Per-slot shadow tables (reference
         python/ps/parameters.py:169-183): zeros-initialized, same dim."""
-        for name, table in self.embeddings.items():
-            for slot in slot_names:
-                key = slot_table_name(name, slot)
-                if key not in self.slot_tables:
-                    self.slot_tables[key] = NativeEmbeddingTable(
-                        table.dim, "zeros"
-                    )
+        with self._lock:
+            for name, table in self.embeddings.items():
+                for slot in slot_names:
+                    key = slot_table_name(name, slot)
+                    if key not in self.slot_tables:
+                        self.slot_tables[key] = NativeEmbeddingTable(
+                            table.dim, "zeros"
+                        )
 
     # -- access -------------------------------------------------------------
 
     def get_dense(self):
+        # Returned by reference, deliberately without this class's
+        # lock (which would synchronize nothing here): values are
+        # updated in place by the optimizer under the SERVICER lock,
+        # and callers iterate under that same lock (see the elastic-
+        # lint baseline entry).
         return self.dense
 
     def pull_embedding_vectors(self, name, ids):
-        return self.embeddings[name].get(ids)
+        # Only the registry lookup needs the lock; the row reads run
+        # concurrently on the native table's rw-lock (the hot RPC must
+        # not serialize behind init/restore/checkpoint).
+        with self._lock:
+            table = self.embeddings[name]
+        return table.get(ids)
 
     def to_checkpoint_payload(self):
-        dense = {k: v.copy() for k, v in self.dense.items()}
-        embeddings = {}
-        for name, table in self.embeddings.items():
-            ids, values = table.export()
-            embeddings[name] = (ids, values)
-        for name, table in self.slot_tables.items():
-            ids, values = table.export()
-            embeddings["slot:" + name] = (ids, values)
-        return dense, embeddings
+        with self._lock:
+            dense = {k: v.copy() for k, v in self.dense.items()}
+            embeddings = {}
+            for name, table in self.embeddings.items():
+                ids, values = table.export()
+                embeddings[name] = (ids, values)
+            for name, table in self.slot_tables.items():
+                ids, values = table.export()
+                embeddings["slot:" + name] = (ids, values)
+            return dense, embeddings
 
     def restore_from_checkpoint_payload(self, dense, embeddings, infos,
                                         slot_names=()):
-        for name, arr in dense.items():
-            self.dense[name] = np.array(arr, np.float32, copy=True)
-        self.set_embedding_infos(infos)
-        for name, (ids, values) in embeddings.items():
-            if name.startswith("slot:") or not len(ids):
-                continue
-            if name in self.embeddings:
-                self.embeddings[name].set(ids, values)
-        # Recreate optimizer slot tables, then restore their saved rows —
-        # a relaunched shard must resume Adam/Momentum state, not crash on
-        # the first sparse push.
-        self.create_slot_tables(slot_names)
-        for name, (ids, values) in embeddings.items():
-            if not name.startswith("slot:") or not len(ids):
-                continue
-            key = name[len("slot:"):]
-            if key not in self.slot_tables:
-                self.slot_tables[key] = NativeEmbeddingTable(
-                    values.shape[1], "zeros"
-                )
-            self.slot_tables[key].set(ids, values)
-        self.initialized = bool(self.dense) or bool(self.embeddings)
+        # Whole restore is one critical section (reentrant into
+        # set_embedding_infos / create_slot_tables): a pull racing a
+        # relaunched shard's restore must see all-or-nothing.
+        with self._lock:
+            for name, arr in dense.items():
+                self.dense[name] = np.array(arr, np.float32, copy=True)
+            self.set_embedding_infos(infos)
+            for name, (ids, values) in embeddings.items():
+                if name.startswith("slot:") or not len(ids):
+                    continue
+                if name in self.embeddings:
+                    self.embeddings[name].set(ids, values)
+            # Recreate optimizer slot tables, then restore their saved
+            # rows — a relaunched shard must resume Adam/Momentum
+            # state, not crash on the first sparse push.
+            self.create_slot_tables(slot_names)
+            for name, (ids, values) in embeddings.items():
+                if not name.startswith("slot:") or not len(ids):
+                    continue
+                key = name[len("slot:"):]
+                if key not in self.slot_tables:
+                    self.slot_tables[key] = NativeEmbeddingTable(
+                        values.shape[1], "zeros"
+                    )
+                self.slot_tables[key].set(ids, values)
+            self.initialized = bool(self.dense) or bool(self.embeddings)

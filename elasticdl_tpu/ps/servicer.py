@@ -19,6 +19,7 @@ import numpy as np
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils import tensor_codec
+from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -65,11 +66,17 @@ class PserverServicer:
 
     # -- RPCs ---------------------------------------------------------------
 
+    @rpc_error_guard
     def push_model(self, request, _context=None):
-        self._params.init_from_model_pb(request)
-        self._params.create_slot_tables(self._opt.slot_names)
+        # Under the servicer lock: slot-table creation must not overlap
+        # a concurrent push's apply (init_from_model_pb is internally
+        # idempotent, but the slot tables are not).
+        with self._lock:
+            self._params.init_from_model_pb(request)
+            self._params.create_slot_tables(self._opt.slot_names)
         return pb.Empty()
 
+    @rpc_error_guard
     def push_embedding_table_infos(self, request, _context=None):
         _, _, infos, _ = tensor_codec.pb_to_model(request)
         with self._lock:
@@ -77,6 +84,7 @@ class PserverServicer:
             self._params.create_slot_tables(self._opt.slot_names)
         return pb.Empty()
 
+    @rpc_error_guard
     def pull_dense_parameters(self, request, _context=None):
         res = pb.PullDenseParametersResponse()
         # Serialize against in-place kernel updates so pulls never see a
@@ -95,6 +103,7 @@ class PserverServicer:
                     )
         return res
 
+    @rpc_error_guard
     def pull_embedding_vectors(self, request, _context=None):
         # No servicer lock: the native table's rw-lock (kernels.cc)
         # makes each ROW read/write atomic, so embedding traffic from
@@ -111,6 +120,7 @@ class PserverServicer:
         )
         return tensor_codec.ndarray_to_pb(vectors)
 
+    @rpc_error_guard
     def push_gradients(self, request, _context=None):
         dense, embeddings, _, grad_version = tensor_codec.pb_to_model(
             request.gradients
@@ -124,10 +134,10 @@ class PserverServicer:
                         1, self._params.version - grad_version
                     )
                     lr_mult = 1.0 / staleness
-                self._apply(dense, embeddings, lr_mult, lr_override)
+                self._apply_locked(dense, embeddings, lr_mult, lr_override)
                 self._params.version += 1
                 version = self._params.version
-                self._post_update()
+                self._post_update_locked()
                 self.counters["push_accepted"] += 1
                 return pb.PushGradientsResponse(
                     accepted=True, version=version
@@ -146,15 +156,16 @@ class PserverServicer:
                 return pb.PushGradientsResponse(
                     accepted=True, version=self._params.version
                 )
-            dense_sum, emb_cat = self._reduce_buffer()
+            dense_sum, emb_cat = self._reduce_buffer_locked()
             self._grad_buffer.clear()
-            self._apply(dense_sum, emb_cat, 1.0, lr_override)
+            self._apply_locked(dense_sum, emb_cat, 1.0, lr_override)
             self._params.version += 1
             version = self._params.version
-            self._post_update()
+            self._post_update_locked()
             self.counters["push_accepted"] += 1
             return pb.PushGradientsResponse(accepted=True, version=version)
 
+    @rpc_error_guard
     def prepare_gradients(self, request, _context=None):
         """Phase 1 of the cross-shard atomic sync push: run the staleness
         check and stage the gradients.  Nothing is applied until commit,
@@ -185,6 +196,7 @@ class PserverServicer:
                 accepted=True, version=self._params.version
             )
 
+    @rpc_error_guard
     def commit_gradients(self, request, _context=None):
         """Phase 2: fold the staged entry into the sync buffer (or apply
         immediately in async mode), or drop it on abort.  Commit is
@@ -202,26 +214,26 @@ class PserverServicer:
             self.counters["push_accepted"] += 1
             dense, embeddings, lr_override, _ = staged
             if self._use_async:
-                self._apply(dense, embeddings, 1.0, lr_override)
+                self._apply_locked(dense, embeddings, 1.0, lr_override)
                 self._params.version += 1
-                self._post_update()
+                self._post_update_locked()
                 return pb.PushGradientsResponse(
                     accepted=True, version=self._params.version
                 )
             self._grad_buffer.append((dense, embeddings))
             if len(self._grad_buffer) >= self._grads_to_wait:
-                dense_sum, emb_cat = self._reduce_buffer()
+                dense_sum, emb_cat = self._reduce_buffer_locked()
                 self._grad_buffer.clear()
-                self._apply(dense_sum, emb_cat, 1.0, lr_override)
+                self._apply_locked(dense_sum, emb_cat, 1.0, lr_override)
                 self._params.version += 1
-                self._post_update()
+                self._post_update_locked()
             return pb.PushGradientsResponse(
                 accepted=True, version=self._params.version
             )
 
     # -- internals ----------------------------------------------------------
 
-    def _reduce_buffer(self):
+    def _reduce_buffer_locked(self):
         """Average dense grads; concatenate sparse grads (summing happens
         per-id inside the kernels after a merge)."""
         n = len(self._grad_buffer)
@@ -250,7 +262,7 @@ class PserverServicer:
         }
         return dense_sum, merged
 
-    def _apply(self, dense, embeddings, lr_mult, lr_override):
+    def _apply_locked(self, dense, embeddings, lr_mult, lr_override):
         emb = {}
         for name, (values, ids) in embeddings.items():
             values, ids = tensor_codec.merge_indexed_slices(values, ids)
@@ -272,7 +284,7 @@ class PserverServicer:
 
     def _checkpoint_locked(self):
         """Body of checkpoint_now; caller holds self._lock (the
-        periodic path _post_update already runs under it — the lock is
+        periodic path _post_update_locked already runs under it — the lock is
         not reentrant)."""
         if self._checkpoint_saver is None:
             return
@@ -294,7 +306,7 @@ class PserverServicer:
             # never fail the worker's push RPC.
             logger.warning("checkpoint at v%d failed: %s", v, e)
 
-    def _post_update(self):
+    def _post_update_locked(self):
         v = self._params.version
         if (
             self._checkpoint_saver is not None

@@ -18,9 +18,11 @@ def resolve_export_dir(path):
     """Accept either a direct export dir or a TF-Serving-style
     versioned base (``path/<N>/`` numeric subdirs): return the dir
     holding the highest COMPLETE version (manifest.json present — the
-    exporter writes it last).  Standalone re-implementation of
-    ``serving.export.latest_version`` so this file keeps importing
-    nothing from the framework."""
+    exporter writes it last).  This is the ONE canonical version scan:
+    ``serving.export`` deliberately defers to it rather than keeping a
+    second copy (see the comment there), and this file stays
+    framework-import-free so it can be vendored into a serving process
+    alone."""
     if os.path.isfile(os.path.join(path, "manifest.json")):
         return path
     best = None

@@ -5,12 +5,47 @@ elasticdl/go/pkg/ps/server.go:31-34): a full dense pull of a ~90 MB model
 must fit in one message.
 """
 
+import functools
 import socket
 from concurrent import futures
 
 import grpc
 
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
 MAX_MESSAGE_BYTES = 256 * 1024 * 1024
+
+
+def rpc_error_guard(method):
+    """Servicer-method wrapper: no raw exception escapes as UNKNOWN.
+
+    An unhandled servicer exception reaches the worker as an opaque
+    UNKNOWN status with no server-side log line — on the elastic
+    control plane that becomes a silent re-rendezvous or a burned task
+    retry with no diagnosis.  This wrapper logs the full traceback
+    server-side and aborts the RPC with INTERNAL plus the exception
+    text.  Direct in-process calls (tests pass context=None) just get
+    the logged re-raise.  Enforced by elastic-lint rule EL002."""
+
+    @functools.wraps(method)
+    def wrapper(self, request, context=None):
+        try:
+            return method(self, request, context)
+        except Exception as e:
+            logger.exception(
+                "servicer %s.%s failed",
+                type(self).__name__, method.__name__,
+            )
+            if context is not None and not isinstance(e, grpc.RpcError):
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    "%s failed: %s" % (method.__name__, e),
+                )
+            raise
+
+    return wrapper
 
 CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),

@@ -75,6 +75,7 @@ class DataShardService:
         """Count consumed records; auto-complete tasks as shards drain."""
         count = batch_size or self._batch_size
         self._mc.report_batch_done(count)
+        done = []
         with self._lock:
             self._record_count += count
             self.exec_counters["batch_count"] += 1
@@ -82,9 +83,13 @@ class DataShardService:
             while self._pending and self._record_count >= self._pending[0].size:
                 task = self._pending.popleft()
                 self._record_count -= task.size
-                self._mc.report_task_result(
-                    task.id, exec_counters=self.exec_counters
-                )
+                done.append(task.id)
+            # Snapshot inside, RPC outside: a slow/retrying master must
+            # stall only this caller, not every thread entering
+            # fetch_task/report_batch_done for the RPC's duration.
+            counters = dict(self.exec_counters) if done else None
+        for task_id in done:
+            self._mc.report_task_result(task_id, exec_counters=counters)
 
     def report_task_failed(self, task, err_message, requeue=False):
         """``requeue``: hand the task back WITHOUT consuming one of its
@@ -114,7 +119,11 @@ class DataShardService:
                 self._pending.remove(task)
             except ValueError:
                 pass
-        self._mc.report_task_result(task.id, exec_counters=self.exec_counters)
+            # Snapshot under the lock: the dict is mutated by
+            # report_batch_done from other threads, and the gRPC client
+            # iterates it during serialization.
+            counters = dict(self.exec_counters)
+        self._mc.report_task_result(task.id, exec_counters=counters)
 
 
 class RecordIndexService(DataShardService):

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# elastic-lint entrypoint: project-native static analysis (EL001-EL004)
+# plus a bytecode-compile sweep.  Exits nonzero on any finding — wired
+# into scripts/preflight.py and enforced in tier-1 by
+# tests/test_elastic_lint.py::test_repo_is_lint_clean.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m tools.elastic_lint elasticdl_tpu tools scripts
+python -m compileall -q elasticdl_tpu tools scripts tests
+echo "elastic-lint: clean"

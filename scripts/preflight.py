@@ -2,9 +2,11 @@
 
 Runs, in order, each in a fresh subprocess with the CPU platform pinned:
 
-  1. the full test suite (pytest tests -q)
-  2. the driver's multi-chip dry run (__graft_entry__.dryrun_multichip(8))
-  3. one bench.py pass (CPU; validates the JSON contract end-to-end)
+  1. elastic-lint + compileall (scripts/lint.sh — static analysis of
+     the elastic control plane, EL001-EL004)
+  2. the full test suite (pytest tests -q)
+  3. the driver's multi-chip dry run (__graft_entry__.dryrun_multichip(8))
+  4. one bench.py pass (CPU; validates the JSON contract end-to-end)
 
 Exits nonzero on the FIRST failure with the failing stage named.  Run it
 before every end-of-round snapshot — round 2 shipped a broken HEAD
@@ -54,6 +56,17 @@ def run_stage(name, argv, extra_env=None, timeout=2400):
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     fast = "--fast" in argv
+
+    # Cheapest gate first: static analysis + compile sweep (~seconds)
+    # catches control-plane lock/servicer/thread regressions before
+    # the 10-minute suite spends any time.
+    ok, _ = run_stage(
+        "elastic-lint",
+        ["bash", os.path.join(REPO, "scripts", "lint.sh")],
+        timeout=300,
+    )
+    if not ok:
+        return 1
 
     ok, _ = run_stage(
         "pytest", [sys.executable, "-m", "pytest", "tests", "-q"],

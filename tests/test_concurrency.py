@@ -1,12 +1,27 @@
 """Thread-safety under concurrent pushes/pulls (reference pattern:
-staleness_aware_test.py:25-90 with ThreadPoolExecutor)."""
+staleness_aware_test.py:25-90 with ThreadPoolExecutor).
 
+The task-manager drill additionally runs under elastic-lint's runtime
+lock-discipline tracer (tools/elastic_lint/runtime_tracer.py) — the
+dynamic half of rule EL001: every access to the guarded queue state
+observed during the drill must hold the lock."""
+
+import os
+import sys
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 from elasticdl_tpu.worker.ps_client import PSClient
 from tests.test_pserver import start_ps, stop_all
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # tools/ is repo tooling, not installed
+    sys.path.insert(0, REPO)
+
+from tools.elastic_lint.runtime_tracer import (  # noqa: E402
+    LockDisciplineTracer,
+)
 
 
 def test_concurrent_async_pushes_all_apply():
@@ -105,10 +120,20 @@ def test_task_manager_concurrent_get_report():
             done += 1
         return done
 
-    with ThreadPoolExecutor(8) as pool:
-        counts = list(pool.map(consume, range(8)))
-    assert sum(counts) == 400
-    assert tm.finished()
+    with LockDisciplineTracer() as tracer:
+        tracer.register(tm, attrs=[
+            "_todo", "_doing", "_task_id", "_epoch",
+            "_train_end_callback_pending", "_train_end_callback_done",
+            "_max_task_completed_time", "completed_counts",
+            "failed_counts",
+        ])
+        with ThreadPoolExecutor(8) as pool:
+            counts = list(pool.map(consume, range(8)))
+        assert sum(counts) == 400
+        assert tm.finished()
+    # Dynamic EL001: no guarded attribute was touched off-lock during
+    # the drill (would have been invisible to a pass/fail count).
+    tracer.assert_clean()
 
 
 def test_concurrent_pulls_race_pushes_on_same_table():

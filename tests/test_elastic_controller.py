@@ -273,3 +273,93 @@ def test_coordinator_factory_failure_defers_commit():
     assert (rank, size, epoch) == (0, 1, 1)
     assert addr == "jaxsvc://localhost:40001"
     assert calls["n"] == 2
+
+
+class _ScriptedMC:
+    """Master client returning a scripted get_comm_rank sequence (the
+    last entry repeats)."""
+
+    def __init__(self, responses):
+        self._responses = list(responses)
+        self.loop_statuses = []
+
+    def get_comm_rank(self):
+        class Res:
+            pass
+
+        res = Res()
+        (res.rendezvous_id, res.rank_id, res.world_size,
+         res.coordinator_addr) = (
+            self._responses.pop(0) if len(self._responses) > 1
+            else self._responses[0]
+        )
+        return res
+
+    def report_train_loop_status(self, status):
+        self.loop_statuses.append(status)
+
+
+def test_await_new_epoch_never_reinits_as_nonmember():
+    """ADVICE r5 low: a new epoch can commit WITHOUT this host (grace
+    window batching); await_new_epoch must keep polling until rank >= 0
+    instead of building a coordination client with process_id=-1."""
+    mc = _ScriptedMC([
+        (2, -1, 2, "jaxsvc://x:1"),  # epoch changed, we're not in it
+        (2, -1, 2, "jaxsvc://x:1"),
+        (3, 1, 3, "jaxsvc://x:2"),   # next epoch admits us
+    ])
+    trainer = FakeTrainer()
+    built = []
+    controller = ElasticCollectiveController(
+        mc, trainer, global_batch_num=3,
+        mesh_builder=lambda r, w, c: built.append((r, w)) or ("m", w),
+    )
+    controller._rendezvous.rendezvous_id = 1  # was a member of epoch 1
+    controller._rendezvous.rank = 0
+    assert controller.await_new_epoch(timeout=5.0, poll_secs=0.01)
+    assert built == [(1, 3)], built  # never called with rank=-1
+    assert trainer.rebuilds == [("m", 3)]
+
+
+def test_step_check_skips_reinit_while_excluded():
+    """The cadence path has the same guard: an epoch that excludes this
+    host must not trigger _reinit_world (rank=-1) — it must DETACH
+    (the old epoch's service gets reaped, and an attached client dies
+    with it) and re-announce LOOP_START so the master re-admits us."""
+    mc = _ScriptedMC([
+        (1, 0, 1, ""),               # first init: world of 1
+        (2, -1, 2, "jaxsvc://x:1"),  # bumped epoch excludes us
+        (3, 0, 3, "jaxsvc://x:2"),   # re-admitted
+    ])
+    trainer = FakeTrainer()
+    built = []
+    controller = ElasticCollectiveController(
+        mc, trainer, check_steps=1,
+        mesh_builder=lambda r, w, c: built.append((r, w)) or ("m", w),
+    )
+    controller.step_check()          # init at world 1
+    controller.step_check()          # excluded epoch: detach, no rebuild
+    assert built == [(0, 1)], built  # no rebuild with rank=-1
+    assert mc.loop_statuses == [pb.LOOP_START]  # re-announced ourselves
+    controller.step_check()          # re-admitted: rebuild now
+    assert built == [(0, 1), (0, 3)], built
+
+
+def test_derive_reap_secs_tracks_check_cadence(monkeypatch):
+    """ADVICE r5 medium: the old-epoch service must outlive the
+    survivors' worst-case epoch discovery (check cadence + margin),
+    not a fixed 30 s."""
+    from elasticdl_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("ELASTICDL_STEP_SECS_BOUND", "5.0")
+    monkeypatch.setenv("ELASTICDL_COLLECTIVE_HEARTBEAT", "10")
+    # step-count cadence: 8 steps * 5 s bound + 2*10 s margin
+    assert dist.derive_reap_secs(check_steps=8) == 8 * 5.0 + 20.0
+    # wall-clock cadence dominates when larger
+    assert dist.derive_reap_secs(check_steps=2, check_secs=120.0) == 140.0
+    # no cadence configured: the default check interval + margin
+    assert dist.derive_reap_secs() == 20.0 + 20.0
+    # the service default derives rather than hard-coding 30 s
+    svc = dist.MasterCoordinationService()
+    assert svc._reap_secs == dist.derive_reap_secs()
+    assert dist.MasterCoordinationService(reap_secs=7.5)._reap_secs == 7.5

@@ -1,0 +1,318 @@
+"""elastic-lint rule suite: every rule catches its known-bad fixture
+and stays quiet on the matching known-good one; the runtime tracer
+flags a deliberately unsynchronized counter; and the repo itself is
+lint-clean (the tier-1 CI gate for the whole checker)."""
+
+import os
+import sys
+import textwrap
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # tools/ is not an installed package
+    sys.path.insert(0, REPO)
+
+from tools.elastic_lint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    check_source,
+    run_paths,
+)
+from tools.elastic_lint.runtime_tracer import (  # noqa: E402
+    LockDisciplineTracer,
+)
+
+
+def rules_hit(source):
+    return {f.rule for f in check_source(textwrap.dedent(source))}
+
+
+# -- EL001 lock-discipline ----------------------------------------------
+
+
+EL001_BAD = """
+    import threading
+
+    class Queueish:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._closed = False
+
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def drain(self):
+            out = list(self._items)   # read outside the lock
+            return out
+
+        def close(self):
+            self._closed = True       # written outside the lock
+
+        def is_closed(self):
+            with self._lock:
+                return self._closed
+"""
+
+EL001_GOOD = """
+    import threading
+
+    class Queueish:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._closed = False
+
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def drain(self):
+            with self._lock:
+                return list(self._items)
+
+        def _drain_locked(self):
+            return list(self._items)  # caller-holds-lock convention
+
+        def close(self):
+            with self._lock:
+                self._closed = True
+"""
+
+
+def test_el001_flags_unlocked_access():
+    findings = check_source(textwrap.dedent(EL001_BAD))
+    symbols = {f.symbol for f in findings if f.rule == "EL001"}
+    assert "Queueish.drain._items" in symbols
+    assert "Queueish.close._closed" in symbols
+
+
+def test_el001_quiet_on_disciplined_class():
+    assert "EL001" not in rules_hit(EL001_GOOD)
+
+
+def test_el001_inline_suppression_requires_reason():
+    suppressed = EL001_BAD.replace(
+        "out = list(self._items)   # read outside the lock",
+        "out = list(self._items)  # elint: disable=EL001 -- snapshot",
+    )
+    findings = check_source(textwrap.dedent(suppressed))
+    assert not any(f.symbol == "Queueish.drain._items"
+                   for f in findings)
+    reasonless = EL001_BAD.replace(
+        "out = list(self._items)   # read outside the lock",
+        "out = list(self._items)  # elint: disable=EL001",
+    )
+    findings = check_source(textwrap.dedent(reasonless))
+    # no silent pass: the naked pragma is itself reported
+    assert any(f.rule == "ELSUP" for f in findings)
+
+
+# -- EL002 servicer-safety ----------------------------------------------
+
+
+EL002_BAD = """
+    class ThingServicer:
+        def get_thing(self, request, _context=None):
+            return request.id
+"""
+
+EL002_GOOD = """
+    from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
+
+    class ThingServicer:
+        @rpc_error_guard
+        def get_thing(self, request, _context=None):
+            return request.id
+
+        def helper(self, a, b):
+            return a + b
+"""
+
+
+def test_el002_flags_unguarded_rpc():
+    assert "EL002" in rules_hit(EL002_BAD)
+
+
+def test_el002_quiet_on_guarded_rpc():
+    assert "EL002" not in rules_hit(EL002_GOOD)
+
+
+def test_el002_guard_wrapper_aborts_with_status():
+    class FakeContext:
+        def __init__(self):
+            self.code = None
+
+        def abort(self, code, details):
+            self.code = code
+            raise RuntimeError("aborted: %s" % details)
+
+    from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
+
+    class Servicer:
+        @rpc_error_guard
+        def boom(self, request, _context=None):
+            raise ValueError("kaput")
+
+    ctx = FakeContext()
+    try:
+        Servicer().boom(object(), ctx)
+    except RuntimeError as e:
+        assert "kaput" in str(e)
+    else:
+        raise AssertionError("abort did not propagate")
+    assert ctx.code is not None
+
+
+# -- EL003 jit-purity ---------------------------------------------------
+
+
+EL003_BAD = """
+    import jax
+
+    def build(self, log):
+        def step(params, batch):
+            print("tracing", params)      # trace-time only
+            log["count"] += 1             # closed-over host mutation
+            return params
+
+        return jax.jit(step)
+"""
+
+EL003_GOOD = """
+    import jax
+
+    def build(self):
+        def step(params, batch):
+            acc = {}
+            acc["loss"] = batch.sum()     # local, fine
+            return params, acc
+
+        return jax.jit(step, donate_argnums=(0,))
+"""
+
+
+def test_el003_flags_impure_traced_fn():
+    findings = [f for f in check_source(textwrap.dedent(EL003_BAD))
+                if f.rule == "EL003"]
+    messages = " ".join(f.message for f in findings)
+    assert "print" in messages
+    assert "closed-over host state 'log'" in messages
+
+
+def test_el003_quiet_on_pure_traced_fn():
+    assert "EL003" not in rules_hit(EL003_GOOD)
+
+
+# -- EL004 thread-hygiene ----------------------------------------------
+
+
+EL004_BAD = """
+    import threading
+
+    def run(target):
+        worker = threading.Thread(target=target)
+        worker.start()
+"""
+
+EL004_GOOD = """
+    import threading
+
+    def run(target):
+        worker = threading.Thread(target=target, daemon=True)
+        worker.start()
+
+    def run_and_wait(target):
+        worker = threading.Thread(target=target)
+        worker.start()
+        worker.join()
+"""
+
+
+def test_el004_flags_unjoined_nondaemon_thread():
+    assert "EL004" in rules_hit(EL004_BAD)
+
+
+def test_el004_quiet_on_daemonized_or_joined():
+    assert "EL004" not in rules_hit(EL004_GOOD)
+
+
+# -- runtime tracer -----------------------------------------------------
+
+
+class _Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump_racy(self):
+        self.value += 1  # deliberately unsynchronized
+
+    def bump_locked(self):
+        with self._lock:
+            self.value += 1
+
+
+def _hammer(fn, n_threads=8, n_calls=200):
+    # Dedicated threads (not a pool): a pool worker can steal every
+    # task and leave the access log single-threaded, which is exactly
+    # the pattern the tracer rightly considers race-free.
+    start = threading.Barrier(n_threads)
+
+    def body():
+        start.wait()
+        for _ in range(n_calls):
+            fn()
+
+    threads = [threading.Thread(target=body) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def test_tracer_flags_unsynchronized_counter():
+    counter = _Counter()
+    with LockDisciplineTracer() as tracer:
+        tracer.register(counter, attrs=["value"])
+        _hammer(counter.bump_racy)
+    problems = tracer.violations()
+    assert problems, "racy counter not flagged"
+    assert any(attr == "value" for _, attr, _ in problems)
+
+
+def test_tracer_quiet_on_locked_counter():
+    counter = _Counter()
+    with LockDisciplineTracer() as tracer:
+        tracer.register(counter, attrs=["value"])
+        _hammer(counter.bump_locked)
+    tracer.assert_clean()
+    assert counter.value == 8 * 200
+
+
+def test_tracer_restores_class_on_exit():
+    counter = _Counter()
+    with LockDisciplineTracer() as tracer:
+        tracer.register(counter, attrs=["value"])
+        assert type(counter).__name__ == "Traced_Counter"
+    assert type(counter) is _Counter
+    counter.bump_locked()  # still functional un-instrumented
+    assert counter.value == 1
+
+
+# -- the repo gate ------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    """Tier-1 enforcement: the package must stay clean under
+    EL001-EL004 (modulo the justified baseline).  A regression here
+    means a new unsynchronized access, unguarded servicer RPC, impure
+    traced function, or shutdown-less thread entered the codebase."""
+    findings = run_paths(
+        [os.path.join(REPO, "elasticdl_tpu"),
+         os.path.join(REPO, "tools")],
+        baseline_path=DEFAULT_BASELINE,
+    )
+    assert not findings, "\n".join(
+        "%s:%d: %s %s" % (f.path, f.line, f.rule, f.message)
+        for f in findings)

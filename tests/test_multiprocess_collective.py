@@ -23,6 +23,14 @@ from elasticdl_tpu.master.rendezvous import RendezvousServer
 from elasticdl_tpu.master.task_manager import TaskManager
 from elasticdl_tpu.utils.grpc_utils import find_free_port
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # tools/ is repo tooling, not installed
+    sys.path.insert(0, REPO)
+
+from tools.elastic_lint.runtime_tracer import (  # noqa: E402
+    LockDisciplineTracer,
+)
+
 _WORKER_PROG = r"""
 import os, sys, time
 
@@ -222,6 +230,16 @@ def test_worker_churn_mid_collective_reforms_world():
     manager = WorkerManager(backend, num_workers=3)
     master = Master(task_manager, rendezvous_server=rendezvous,
                     worker_manager=manager)
+    # Dynamic EL001 over the REAL churn: the master-side epoch state is
+    # hammered by gRPC pool threads (join/leave/rank RPCs), the worker
+    # watcher threads, and this test thread — every access must hold
+    # the respective lock (tools/elastic_lint/runtime_tracer.py).
+    tracer = LockDisciplineTracer()
+    tracer.register(rendezvous, attrs=[
+        "_cur_hosts", "_next_hosts", "_rendezvous_id", "_last_change",
+        "_coordinator_addr",
+    ])
+    tracer.register(task_manager, attrs=["_todo", "_doing"])
     try:
         master.prepare()
         deadline = time.time() + 120
@@ -268,7 +286,9 @@ def test_worker_churn_mid_collective_reforms_world():
         repl = results[3]["events"]
         assert repl and repl[0]["world"] == 3, repl[:3]
         assert repl[0]["w"] < 3.6, repl[0]
+        tracer.assert_clean()
     finally:
+        tracer.restore()
         master.stop()
         for proc in backend.procs.values():
             if proc.poll() is None:

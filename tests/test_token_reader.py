@@ -134,3 +134,41 @@ def test_dtype_sidecar_guards_appends_and_reads(tmp_path):
     write_token_file(path, [5, 6])
     assert TokenFileDataReader(path, seq_len=2).create_shards() == [
         (path, 0, 3)]
+
+
+def test_truncated_or_stale_shard_fails_loudly(tmp_path):
+    """A shard range beyond the file's real length must raise a clear
+    error, not silently yield short windows that break the static
+    [B, T] batch shape downstream (ADVICE r5 low)."""
+    path = str(tmp_path / "trunc.bin")
+    _make_file(path, n_tokens=16 * 10)
+    reader = TokenFileDataReader(path, seq_len=16, records_per_shard=4)
+    # Warm the mmap on the full file, then truncate it underneath the
+    # reader — the stale-shard / truncated-file scenario.
+    class T:
+        class shard:
+            start, end = 8, 10
+            record_indices = None
+
+    assert len(list(reader.read_records(T))) == 2
+    with open(path, "r+b") as f:
+        f.truncate(16 * 9 * 2)  # drop the last uint16 window
+    reader2 = TokenFileDataReader(path, seq_len=16, records_per_shard=4)
+    with pytest.raises(ValueError, match="truncated|stale"):
+        list(reader2.read_records(T))
+
+
+def test_shuffle_indices_out_of_range_fail_loudly(tmp_path):
+    """Stale resume metadata (record_indices from a longer file) hits
+    the same bounds check."""
+    path = str(tmp_path / "stale.bin")
+    _make_file(path, n_tokens=16 * 4)
+    reader = TokenFileDataReader(path, seq_len=16)
+
+    class T:
+        class shard:
+            start, end = 0, 2
+            record_indices = [1, 99]  # 99 is beyond the 4 windows
+
+    with pytest.raises(ValueError, match="out of range"):
+        list(reader.read_records(T))

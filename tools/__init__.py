@@ -1,0 +1,2 @@
+# Repo tooling namespace (elastic_lint lives here; not shipped in the
+# elasticdl_tpu wheel — see pyproject [tool.setuptools.packages.find]).

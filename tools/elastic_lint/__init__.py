@@ -1,0 +1,108 @@
+"""elastic-lint — project-native static analysis for the elastic control plane.
+
+Generic linters cannot see the invariants this codebase's elasticity
+depends on: which attributes a class's ``self._lock`` actually guards,
+whether a gRPC servicer method can leak a raw exception to a worker as
+an opaque UNKNOWN status, whether a traced-and-jitted function smuggles
+a Python side effect past XLA, or whether a thread is left running with
+no shutdown path.  Round-5 advisories found exactly these classes of
+bug (epoch/rank races in parallel/distributed.py and api/controller.py)
+— this package mechanically enforces them.
+
+Rules (each in its own module, registered in ``RULES``):
+
+  EL001 lock-discipline   an attribute mutated under ``with self._lock``
+                          in one method must never be read or mutated
+                          outside the lock elsewhere in that class
+  EL002 servicer-safety   gRPC servicer methods must not let raw
+                          exceptions escape without a status code
+                          (enforced via the ``rpc_error_guard`` wrapper)
+  EL003 jit-purity        no Python side effects (print, host-state
+                          mutation, global/nonlocal, IO) inside
+                          jit/pmap/shard_map-traced functions
+  EL004 thread-hygiene    every ``threading.Thread``/``Timer`` must be
+                          daemonized or joined
+
+Suppressions (both forms REQUIRE a justification after ``--``):
+
+  inline   ``# elint: disable=EL001 -- reason`` on the flagged line or
+           the immediately preceding line
+  baseline ``tools/elastic_lint/baseline.txt`` lines of the form
+           ``RULE path symbol -- reason`` (symbol as reported, e.g.
+           ``PserverServicer.pull_embedding_vectors.counters``)
+
+Adding a rule: create ``el0xx_name.py`` exposing ``RULE_ID`` and
+``check(tree, source, path) -> [Finding]``, then append it to ``RULES``.
+The runtime half (a ThreadSanitizer-lite for the same lock-discipline
+invariant) lives in ``runtime_tracer``.
+"""
+
+import ast
+import os
+from collections import namedtuple
+
+# (rule, path, line, symbol, message) — symbol is the stable handle the
+# baseline file matches on; line is for humans.
+Finding = namedtuple("Finding", ["rule", "path", "line", "symbol", "message"])
+
+from tools.elastic_lint import (  # noqa: E402  (Finding must exist first)
+    el001_lock_discipline,
+    el002_servicer_safety,
+    el003_jit_purity,
+    el004_thread_hygiene,
+    suppressions,
+)
+
+RULES = (
+    el001_lock_discipline,
+    el002_servicer_safety,
+    el003_jit_purity,
+    el004_thread_hygiene,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+
+def check_source(source, path="<string>", rules=RULES):
+    """Run ``rules`` over one file's source; returns raw findings
+    (inline pragmas applied, baseline NOT applied) — the unit-test
+    entry point for known-good/known-bad fixtures."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("E999", path, e.lineno or 0, "<parse>",
+                        "syntax error: %s" % e.msg)]
+    findings = []
+    for rule in rules:
+        findings.extend(rule.check(tree, source, path))
+    return suppressions.apply_inline(findings, source)
+
+
+def iter_python_files(paths):
+    for root in paths:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def run_paths(paths, baseline_path=DEFAULT_BASELINE, rules=RULES):
+    """Lint every .py under ``paths``; returns findings that survive
+    both inline pragmas and the baseline file."""
+    baseline = suppressions.load_baseline(baseline_path)
+    findings = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+        findings.extend(check_source(source, rel, rules=rules))
+    return suppressions.apply_baseline(findings, baseline)

@@ -1,0 +1,39 @@
+"""CLI: ``python -m tools.elastic_lint [paths...]``.
+
+Exits 1 when findings survive inline pragmas and the baseline file,
+0 on a clean run.  ``--no-baseline`` reports everything (audit mode).
+"""
+
+import argparse
+import sys
+
+from tools.elastic_lint import DEFAULT_BASELINE, REPO_ROOT, run_paths
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        "elastic-lint",
+        description="project-native static analysis (EL001-EL004)")
+    parser.add_argument("paths", nargs="*",
+                        default=["elasticdl_tpu"],
+                        help="files or directories to lint")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline file (full audit)")
+    args = parser.parse_args(argv)
+
+    baseline = None if args.no_baseline else args.baseline
+    findings = run_paths(args.paths, baseline_path=baseline)
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        print("%s:%d: %s [%s] %s"
+              % (f.path, f.line, f.rule, f.symbol, f.message))
+    if findings:
+        print("elastic-lint: %d finding(s)" % len(findings),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    raise SystemExit(main())

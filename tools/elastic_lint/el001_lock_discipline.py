@@ -1,0 +1,249 @@
+"""EL001 — lock-discipline: attributes a class guards with ``self._lock``
+must ALWAYS be accessed under it.
+
+For every class that takes a recognized lock (an attribute assigned
+``threading.Lock()``/``RLock()`` in ``__init__``, or any ``self.*lock*``
+used in a ``with`` statement), the rule derives the *guarded set*:
+
+  1. attributes mutated inside a lock region anywhere in the class
+     (rebinds, augmented assigns, item/sub-attribute stores, and
+     mutating method calls like ``.append``/``.pop``/``.update``), and
+  2. attributes READ inside a lock region that are also mutated
+     anywhere outside ``__init__`` — a read the author bothered to
+     lock implies the attribute is shared-mutable, so an unlocked
+     writer elsewhere is exactly the race the lock was bought to stop.
+
+Any access (read or write) to a guarded attribute outside a lock region
+is a violation.  Conventions honored:
+
+  - ``__init__`` is exempt (the object is not shared yet);
+  - methods named ``*_locked`` are treated as running WITH the lock
+    held (the repo's existing caller-holds-lock convention, e.g.
+    ``TaskManager._finished_training_locked``);
+  - attributes bound to self-synchronized primitives in ``__init__``
+    (``threading.Event``/``Condition``/``Semaphore``, ``queue.Queue``,
+    ``ThreadPoolExecutor``) are exempt, as are the locks themselves.
+
+Scope limits (documented, deliberate): analysis is per-class — a
+*different* object's lock protecting this object's state (the PS
+servicer lock over ``Parameters``) is invisible, as is lock-free
+publication via atomic single assignment; suppress those with a
+justification instead.  Multi-lock classes are analyzed with the UNION
+of their locks: holding ANY recognized lock counts as "inside the
+lock", so an attribute consistently guarded by lock A but touched
+under only lock B passes — the rule proves "never unlocked", not
+"always the RIGHT lock".  Classes that need per-lock discipline
+(serving's ModelEndpoint nests its two locks precisely to avoid this
+ambiguity) should keep lock regions nested or rely on the runtime
+tracer, which checks the actual lock instance.
+"""
+
+import ast
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL001"
+
+MUTATING_CALLS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear",
+    "update", "rotate", "setdefault", "sort", "reverse",
+}
+LOCK_TYPES = {"Lock", "RLock"}
+SELF_SYNC_TYPES = {
+    "Event", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+}
+
+
+def _root_self_attr(node):
+    """First-level attribute name for a chain rooted at ``self``
+    (``self._doing[k].x`` -> ``_doing``), else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        parent = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(parent, ast.Name)
+                and parent.id == "self"):
+            return node.attr
+        node = parent
+    return None
+
+
+def _ctor_name(value):
+    """Type name when ``value`` is a call like ``threading.Lock()``."""
+    if isinstance(value, ast.Call):
+        func = value.func
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        if isinstance(func, ast.Name):
+            return func.id
+    return None
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Record (attr, kind, in_lock, lineno) accesses for one method."""
+
+    def __init__(self, lock_attrs, assume_locked):
+        self._lock_attrs = lock_attrs
+        self._depth = 1 if assume_locked else 0
+        self.accesses = []
+
+    def _record(self, attr, kind, lineno):
+        self.accesses.append((attr, kind, self._depth > 0, lineno))
+
+    # -- lock regions --------------------------------------------------
+
+    def visit_With(self, node):
+        holds = any(
+            isinstance(item.context_expr, ast.Attribute)
+            and isinstance(item.context_expr.value, ast.Name)
+            and item.context_expr.value.id == "self"
+            and item.context_expr.attr in self._lock_attrs
+            for item in node.items
+        )
+        for item in node.items:
+            self.visit(item.context_expr)
+        if holds:
+            self._depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if holds:
+            self._depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    # -- writes --------------------------------------------------------
+
+    def _store(self, target):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._store(elt)
+            return
+        attr = _root_self_attr(target)
+        if attr is not None:
+            self._record(attr, "write", target.lineno)
+            if isinstance(target, ast.Subscript):
+                self.visit(target.slice)
+        else:
+            self.visit(target)
+
+    def visit_Assign(self, node):
+        for target in node.targets:
+            self._store(target)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        self._store(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        self._store(node.target)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_Delete(self, node):
+        for target in node.targets:
+            self._store(target)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Attribute):
+            attr = _root_self_attr(node.func.value)
+            if attr is not None and node.func.attr in MUTATING_CALLS:
+                self._record(attr, "write", node.lineno)
+        self.generic_visit(node)
+
+    # -- reads ---------------------------------------------------------
+
+    def visit_Attribute(self, node):
+        attr = _root_self_attr(node)
+        if attr is not None:
+            self._record(attr, "read", node.lineno)
+            return  # chain fully consumed
+        self.generic_visit(node)
+
+
+def _analyze_class(cls, path, findings):
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    lock_attrs, exempt = set(), set()
+    for method in methods:
+        if method.name != "__init__":
+            continue
+        for node in ast.walk(method):
+            if not isinstance(node, ast.Assign):
+                continue
+            ctor = _ctor_name(node.value)
+            for target in node.targets:
+                attr = _root_self_attr(target)
+                if attr is None:
+                    continue
+                if ctor in LOCK_TYPES:
+                    lock_attrs.add(attr)
+                elif ctor in SELF_SYNC_TYPES:
+                    exempt.add(attr)
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if (isinstance(expr, ast.Attribute)
+                        and isinstance(expr.value, ast.Name)
+                        and expr.value.id == "self"
+                        and "lock" in expr.attr.lower()):
+                    lock_attrs.add(expr.attr)
+    if not lock_attrs:
+        return
+
+    per_method = {}  # method name -> accesses
+    for method in methods:
+        if method.name == "__init__":
+            continue
+        scanner = _MethodScanner(
+            lock_attrs, assume_locked=method.name.endswith("_locked"))
+        for stmt in method.body:
+            scanner.visit(stmt)
+        per_method[method.name] = scanner.accesses
+
+    skip = lock_attrs | exempt
+    locked_writes, locked_reads, any_writes = set(), set(), set()
+    for accesses in per_method.values():
+        for attr, kind, in_lock, _ in accesses:
+            if attr in skip:
+                continue
+            if kind == "write":
+                any_writes.add(attr)
+                if in_lock:
+                    locked_writes.add(attr)
+            elif in_lock:
+                locked_reads.add(attr)
+    guarded = locked_writes | (locked_reads & any_writes)
+    if not guarded:
+        return
+
+    seen = set()
+    for method_name, accesses in per_method.items():
+        for attr, kind, in_lock, lineno in accesses:
+            if attr not in guarded or in_lock:
+                continue
+            key = (method_name, attr, lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                RULE_ID, path, lineno,
+                "%s.%s.%s" % (cls.name, method_name, attr),
+                "'%s.%s' is guarded by %s (mutated under it elsewhere "
+                "in the class) but %s outside the lock in %s()"
+                % (cls.name, attr,
+                   "/".join("self.%s" % a for a in sorted(lock_attrs)),
+                   "written" if kind == "write" else "read",
+                   method_name),
+            ))
+
+
+def check(tree, source, path):
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _analyze_class(node, path, findings)
+    return findings

@@ -1,0 +1,76 @@
+"""EL002 — servicer-safety: gRPC servicer methods must not leak raw
+exceptions.
+
+An exception escaping a servicer method reaches the worker as an opaque
+``UNKNOWN`` status with no server-side log line — on the elastic control
+plane that turns into a silent re-rendezvous or a burned task retry with
+no clue why.  Every RPC method of a ``*Servicer`` class (a public method
+whose second parameter is ``request``) must therefore be wrapped in
+``elasticdl_tpu.utils.grpc_utils.rpc_error_guard``, which logs the
+failure with the method name and aborts the RPC with ``INTERNAL`` plus
+a message instead of letting grpc swallow the traceback.
+
+A hand-rolled try/except that sets a status code is also accepted when
+the method body's top-level statement is a ``try`` whose handler calls
+``context.abort(...)`` or ``context.set_code(...)``.
+"""
+
+import ast
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL002"
+GUARD_NAME = "rpc_error_guard"
+
+
+def _has_guard_decorator(func):
+    for dec in func.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == GUARD_NAME:
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == GUARD_NAME:
+            return True
+    return False
+
+
+def _handler_sets_status(func):
+    """Body is ``try:`` ... ``except`` with context.abort/set_code."""
+    for stmt in func.body:
+        if not isinstance(stmt, ast.Try):
+            continue
+        for handler in stmt.handlers:
+            for node in ast.walk(handler):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("abort", "set_code")):
+                    return True
+    return False
+
+
+def check(tree, source, path):
+    findings = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if not cls.name.endswith("Servicer"):
+            continue
+        for func in cls.body:
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if func.name.startswith("_"):
+                continue
+            args = func.args.args
+            if len(args) < 2 or args[1].arg != "request":
+                continue
+            if _has_guard_decorator(func) or _handler_sets_status(func):
+                continue
+            findings.append(Finding(
+                RULE_ID, path, func.lineno,
+                "%s.%s" % (cls.name, func.name),
+                "servicer RPC %s.%s() can leak a raw exception as an "
+                "opaque UNKNOWN status: decorate it with "
+                "@grpc_utils.rpc_error_guard (or set a status code in "
+                "an except handler)" % (cls.name, func.name),
+            ))
+    return findings

@@ -1,0 +1,188 @@
+"""EL003 — jit-purity: no Python side effects inside traced functions.
+
+A function handed to ``jax.jit``/``pmap``/``shard_map`` runs ONCE at
+trace time; Python side effects in its body silently fire once per
+compile instead of once per step, and host-state mutation from inside a
+trace is a correctness bug (the traced value never lands in the host
+buffer).  Flagged inside any traced function:
+
+  - ``print``/``breakpoint``/``pdb.set_trace`` calls (trace-time only;
+    use ``jax.debug.print`` for per-step output)
+  - ``global``/``nonlocal`` declarations
+  - assignment to ``self.*`` (object state mutated at trace time)
+  - item/attribute stores whose root name is closed over from outside
+    the traced function (host numpy buffers mutated under trace)
+  - ``open()``/``np.save``/``np.savez``/``.tofile`` host IO
+
+Traced functions are found two ways: decorator position (``@jax.jit``,
+``@partial(jax.jit, ...)``, ``@shard_map``) and call position
+(``jax.jit(step)``, ``shard_map(fn, ...)`` where the argument names a
+local ``def``).
+"""
+
+import ast
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL003"
+
+TRACERS = {"jit", "pmap", "shard_map", "pjit", "vmap_of_jit"}
+IO_CALLS = {"save", "savez", "savez_compressed", "tofile", "set_trace"}
+
+
+def _call_target_name(node):
+    """Name of the function being applied: jax.jit -> 'jit'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_tracer_expr(node):
+    """True for ``jax.jit``, ``shard_map``, ``partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Call):
+        name = _call_target_name(node.func)
+        if name in ("partial", "wraps"):
+            return any(_is_tracer_expr(a) for a in node.args)
+        return name in TRACERS
+    return _call_target_name(node) in TRACERS
+
+
+def _collect_traced_functions(tree):
+    """FunctionDefs that end up inside a trace, with their qualname."""
+    traced = []
+
+    def scope_walk(body, prefix):
+        local_defs = {}
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[node.name] = node
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + node.name
+                if any(_is_tracer_expr(dec)
+                       for dec in node.decorator_list):
+                    traced.append((qual, node))
+                scope_walk(node.body, qual + ".")
+            elif isinstance(node, ast.ClassDef):
+                scope_walk(node.body, prefix + node.name + ".")
+            else:
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if not _is_tracer_expr(sub):
+                        continue
+                    for arg in sub.args[:1]:
+                        if (isinstance(arg, ast.Name)
+                                and arg.id in local_defs):
+                            traced.append(
+                                (prefix + arg.id, local_defs[arg.id]))
+    scope_walk(tree.body, "")
+    # Also catch jit(fn) where fn is a sibling def INSIDE a function
+    # body (the dominant pattern here: build_step defines `step` then
+    # returns jax.jit(step)).
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_defs = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not local_defs:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _is_tracer_expr(sub):
+                for arg in sub.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id in local_defs:
+                        traced.append(
+                            (node.name + "." + arg.id,
+                             local_defs[arg.id]))
+    # dedupe by function object
+    seen, out = set(), []
+    for qual, fn in traced:
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append((qual, fn))
+    return out
+
+
+def _local_names(func):
+    """Every name bound anywhere within the traced function tree."""
+    names = {a.arg for a in (func.args.args + func.args.posonlyargs
+                             + func.args.kwonlyargs)}
+    if func.args.vararg:
+        names.add(func.args.vararg.arg)
+    if func.args.kwarg:
+        names.add(func.args.kwarg.arg)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            names.update(a.arg for a in node.args.args)
+        elif isinstance(node, ast.Lambda):
+            names.update(a.arg for a in node.args.args)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _root_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _scan_traced(qual, func, path, findings):
+    locals_ = _local_names(func)
+
+    def flag(lineno, what):
+        findings.append(Finding(
+            RULE_ID, path, lineno, qual,
+            "traced function %s(): %s (side effects fire at trace "
+            "time, not per step)" % (qual, what)))
+
+    for node in ast.walk(func):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            flag(node.lineno, "%s declaration inside a traced function"
+                 % type(node).__name__.lower())
+        elif isinstance(node, ast.Call):
+            name = _call_target_name(node.func)
+            if name in ("print", "breakpoint"):
+                flag(node.lineno,
+                     "%s() call — use jax.debug.print for traced "
+                     "values" % name)
+            elif name == "open":
+                flag(node.lineno, "host IO (open()) under trace")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in IO_CALLS):
+                flag(node.lineno,
+                     "host IO/debugger (.%s) under trace"
+                     % node.func.attr)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    continue
+                root = _root_name(target)
+                if root == "self":
+                    flag(target.lineno,
+                         "mutates self.* state under trace")
+                elif (isinstance(target, (ast.Subscript, ast.Attribute))
+                      and root is not None and root not in locals_):
+                    flag(target.lineno,
+                         "mutates closed-over host state '%s' under "
+                         "trace" % root)
+
+
+def check(tree, source, path):
+    findings = []
+    for qual, func in _collect_traced_functions(tree):
+        _scan_traced(qual, func, path, findings)
+    return findings

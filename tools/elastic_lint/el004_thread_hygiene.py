@@ -1,0 +1,101 @@
+"""EL004 — bare-thread hygiene: every thread gets a shutdown story.
+
+A non-daemon thread that is never joined keeps the process alive after
+the job ends — on the elastic control plane that is a master that never
+exits after ``stop()``, a worker that hangs the relaunch budget, or a
+test suite that wedges CI.  Every ``threading.Thread(...)`` /
+``threading.Timer(...)`` construction must satisfy one of:
+
+  - ``daemon=True`` passed at construction;
+  - ``<var>.daemon = True`` set on the assigned variable/attribute
+    before ``start()``;
+  - a ``.join(...)`` call on the same variable/attribute somewhere in
+    the module (the owner waits for it).
+
+The check is module-local and name-based: it does not chase a thread
+handle across modules — hand such a thread to its owner with a
+``# elint: disable=EL004 -- <who joins it>`` pragma.
+"""
+
+import ast
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL004"
+THREAD_TYPES = {"Thread", "Timer"}
+
+
+def _target_key(node):
+    """Stable key for the variable a thread is bound to: 'name' or
+    'self.attr' (or None for anonymous/immediately-started threads)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)):
+        return "%s.%s" % (node.value.id, node.attr)
+    return None
+
+
+def _is_thread_ctor(call):
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None)
+    return name in THREAD_TYPES
+
+
+def check(tree, source, path):
+    findings = []
+    # Pass 1: module-wide sets of keys that get `.daemon = True` and
+    # keys that get `.join(...)`.
+    daemonized, joined = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (isinstance(target, ast.Attribute)
+                        and target.attr == "daemon"
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True):
+                    key = _target_key(target.value)
+                    if key:
+                        daemonized.add(key)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "join"):
+            key = _target_key(node.func.value)
+            if key:
+                joined.add(key)
+
+    # Pass 2: judge each construction site.  Map assignment-bound
+    # constructor calls to their target keys first, so the generic
+    # Call walk below doesn't double-judge them without their keys.
+    bound_keys = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            bound_keys[id(node.value)] = [
+                _target_key(t) for t in node.targets]
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call) or not _is_thread_ctor(call):
+            continue
+        keys = bound_keys.get(id(call), [])
+        if any(kw.arg == "daemon"
+               and isinstance(kw.value, ast.Constant)
+               and kw.value.value is True
+               for kw in call.keywords):
+            continue
+        keys = [k for k in keys if k]
+        if any(k in daemonized or k in joined for k in keys):
+            continue
+        ctor = (call.func.attr if isinstance(call.func, ast.Attribute)
+                else call.func.id)
+        findings.append(Finding(
+            RULE_ID, path, call.lineno,
+            "%s:%d" % (ctor, call.lineno),
+            "%s created without daemon=True and never joined in this "
+            "module — give it a shutdown path (daemonize, join, or "
+            "suppress naming the joiner)" % ctor,
+        ))
+    # Anonymous `threading.Thread(...).start()` chains appear as bare
+    # Call nodes above and were judged by daemon= alone — correct: an
+    # unnamed thread can never be joined.
+    return findings

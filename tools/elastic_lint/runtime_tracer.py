@@ -1,0 +1,191 @@
+"""ThreadSanitizer-lite: runtime lock-discipline tracing.
+
+The static half (EL001) proves lock discipline for accesses it can see;
+this module catches what static analysis cannot — accesses through
+callbacks, subclasses, or foreign modules.  Register a shared object
+and the attributes its lock guards; while the tracer is active, every
+read/write of those attributes is recorded together with whether the
+object's lock was held by the accessing thread.  ``violations()``
+reports unsynchronized cross-thread access:
+
+  - an attribute written without the lock while any other thread also
+    touches it, or
+  - an attribute accessed without the lock from two or more threads.
+
+Usage (see tests/test_concurrency.py and
+tests/test_multiprocess_collective.py for the live drills)::
+
+    with LockDisciplineTracer() as tracer:
+        tracer.register(task_manager, attrs=["_todo", "_doing"])
+        ... hammer the object from many threads ...
+    tracer.assert_clean()
+
+Instrumentation is reversible and per-instance: the object's class is
+swapped for a generated subclass overriding ``__getattribute__`` /
+``__setattr__``, and its lock is wrapped so ownership is observable
+(``threading.Lock`` has no owner API).  Overhead is a dict append per
+tracked access — fine for drills, not for production.
+"""
+
+import threading
+
+_SELF_SYNC = (threading.Event, threading.Condition, threading.Semaphore)
+
+
+class TrackedLock:
+    """Wraps a Lock/RLock, recording which threads currently hold it."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._holders = {}  # thread ident -> recursion depth
+
+    def acquire(self, *args, **kwargs):
+        acquired = self._inner.acquire(*args, **kwargs)
+        if acquired:
+            ident = threading.get_ident()
+            self._holders[ident] = self._holders.get(ident, 0) + 1
+        return acquired
+
+    def release(self):
+        ident = threading.get_ident()
+        depth = self._holders.get(ident, 0)
+        if depth <= 1:
+            self._holders.pop(ident, None)
+        else:
+            self._holders[ident] = depth - 1
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def held_by_current_thread(self):
+        return threading.get_ident() in self._holders
+
+    def locked(self):
+        return self._inner.locked()
+
+
+class LockDisciplineTracer:
+    def __init__(self):
+        # list.append is GIL-atomic, so concurrent recorders need no
+        # lock of their own (and must not take the traced one).
+        self.events = []
+        self._restores = []
+
+    # -- instrumentation ----------------------------------------------
+
+    def register(self, obj, attrs=None, lock_attr="_lock"):
+        """Instrument ``obj`` so accesses to ``attrs`` are recorded.
+
+        ``attrs=None`` tracks every instance attribute except the lock
+        itself and self-synchronized primitives (Event/Condition/
+        Semaphore/queues).  Call before handing the object to worker
+        threads."""
+        lock = getattr(obj, lock_attr)
+        if not isinstance(lock, TrackedLock):
+            lock = TrackedLock(lock)
+            object.__setattr__(obj, lock_attr, lock)
+        if attrs is None:
+            attrs = [
+                name for name, value in vars(obj).items()
+                if name != lock_attr
+                and not isinstance(value, _SELF_SYNC + (TrackedLock,))
+                and not hasattr(value, "acquire")
+            ]
+        tracked = frozenset(attrs)
+        tracer = self
+        original_cls = type(obj)
+        label = original_cls.__name__
+
+        def _record(target, name, mode):
+            tracer.events.append((
+                id(target), label, name, mode,
+                threading.get_ident(),
+                lock.held_by_current_thread(),
+            ))
+
+        namespace = {
+            "__elint_traced__": True,
+            "__getattribute__": _make_getattribute(tracked, _record),
+            "__setattr__": _make_setattr(tracked, _record),
+        }
+        traced_cls = type("Traced" + label, (original_cls,), namespace)
+        object.__setattr__(obj, "__class__", traced_cls)
+        self._restores.append((obj, original_cls))
+        return obj
+
+    def restore(self):
+        """Un-instrument every registered object (idempotent)."""
+        for obj, original_cls in self._restores:
+            object.__setattr__(obj, "__class__", original_cls)
+        self._restores = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False
+
+    # -- reporting -----------------------------------------------------
+
+    def violations(self):
+        """[(object label, attr, description)] for unsynchronized
+        cross-thread access patterns observed so far."""
+        per_attr = {}
+        for obj_id, label, name, mode, ident, held in self.events:
+            stats = per_attr.setdefault(
+                (obj_id, label, name),
+                {"threads": set(), "unlocked": set(),
+                 "unlocked_writes": set()},
+            )
+            stats["threads"].add(ident)
+            if not held:
+                stats["unlocked"].add(ident)
+                if mode == "write":
+                    stats["unlocked_writes"].add(ident)
+        out = []
+        for (obj_id, label, name), stats in sorted(
+                per_attr.items(), key=lambda kv: (kv[0][1], kv[0][2])):
+            shared = len(stats["threads"]) > 1
+            if stats["unlocked_writes"] and shared:
+                out.append((label, name,
+                            "written without the lock by thread(s) %s "
+                            "while %d thread(s) access it"
+                            % (sorted(stats["unlocked_writes"]),
+                               len(stats["threads"]))))
+            elif len(stats["unlocked"]) > 1:
+                out.append((label, name,
+                            "accessed without the lock from %d "
+                            "different threads"
+                            % len(stats["unlocked"])))
+        return out
+
+    def assert_clean(self):
+        problems = self.violations()
+        if problems:
+            raise AssertionError(
+                "unsynchronized cross-thread access:\n" + "\n".join(
+                    "  %s.%s: %s" % p for p in problems))
+
+
+def _make_getattribute(tracked, record):
+    def __getattribute__(self, name):
+        value = object.__getattribute__(self, name)
+        if name in tracked:
+            record(self, name, "read")
+        return value
+    return __getattribute__
+
+
+def _make_setattr(tracked, record):
+    def __setattr__(self, name, value):
+        if name in tracked:
+            record(self, name, "write")
+        object.__setattr__(self, name, value)
+    return __setattr__

@@ -1,0 +1,86 @@
+"""Suppression engine: inline pragmas and the baseline file.
+
+Both forms require a justification — a suppression without a reason is
+itself reported as a finding (rule ``ELSUP``), so "just silence it"
+cannot creep in.
+"""
+
+import os
+import re
+
+_PRAGMA = re.compile(
+    r"#\s*elint:\s*disable=(?P<rules>[A-Z0-9, ]+?)"
+    r"(?:\s*--\s*(?P<reason>\S.*))?$"
+)
+
+
+def _pragma_rules(line):
+    """Returns (set of rule ids, has_reason) for a source line, or
+    (empty set, True) when no pragma is present."""
+    m = _PRAGMA.search(line)
+    if not m:
+        return set(), True
+    rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+    return rules, bool(m.group("reason"))
+
+
+def apply_inline(findings, source):
+    """Drop findings suppressed by ``# elint: disable=RULE -- reason``
+    on the flagged line or the line directly above it."""
+    from tools.elastic_lint import Finding
+
+    lines = source.splitlines()
+    out = []
+    reported_bad_pragma = set()
+    for f in findings:
+        suppressed = False
+        for lineno in (f.line, f.line - 1):
+            if not (1 <= lineno <= len(lines)):
+                continue
+            rules, has_reason = _pragma_rules(lines[lineno - 1])
+            if f.rule not in rules:
+                continue
+            if not has_reason:
+                if lineno not in reported_bad_pragma:
+                    reported_bad_pragma.add(lineno)
+                    out.append(Finding(
+                        "ELSUP", f.path, lineno, "<pragma>",
+                        "suppression without justification: add "
+                        "'-- <reason>' to the elint pragma",
+                    ))
+                continue
+            suppressed = True
+            break
+        if not suppressed:
+            out.append(f)
+    return out
+
+
+def load_baseline(path):
+    """Parse baseline lines ``RULE path symbol -- reason`` into a set of
+    (rule, path, symbol) keys.  Unparseable or reason-less lines raise:
+    a broken baseline must fail the lint run, not silently allow."""
+    entries = set()
+    if not path or not os.path.isfile(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for n, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "--" not in line:
+                raise ValueError(
+                    "%s:%d: baseline entry missing '-- <reason>': %r"
+                    % (path, n, line))
+            head = line.split("--", 1)[0].split()
+            if len(head) != 3:
+                raise ValueError(
+                    "%s:%d: expected 'RULE path symbol -- reason': %r"
+                    % (path, n, line))
+            entries.add(tuple(head))
+    return entries
+
+
+def apply_baseline(findings, baseline):
+    return [f for f in findings
+            if (f.rule, f.path, f.symbol) not in baseline]
