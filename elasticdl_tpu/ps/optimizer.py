@@ -52,7 +52,13 @@ class Optimizer:
                 name, param, np.ascontiguousarray(grad, np.float32), lr
             )
         for table_name, (values, ids) in embedding_grads.items():
-            self.apply_sparse(params, table_name, ids, values, lr)
+            # The native kernels read raw float32 rows; a reduced-
+            # precision wire decode or a non-contiguous merge result
+            # must never reach them as-is.
+            self.apply_sparse(
+                params, table_name, ids,
+                np.ascontiguousarray(values, np.float32), lr,
+            )
 
     def _slot_table(self, params, table_name, slot):
         return params.slot_tables[slot_table_name(table_name, slot)]

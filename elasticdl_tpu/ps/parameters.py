@@ -112,6 +112,10 @@ class Parameters:
         # not serialize behind init/restore/checkpoint).
         with self._lock:
             table = self.embeddings[name]
+        if np.size(ids) == 0:
+            # Preserve the row dim on empty pulls — (0, 0) breaks
+            # downstream shape assumptions (worker padding, concat).
+            return np.zeros((0, table.dim), np.float32)
         return table.get(ids)
 
     def to_checkpoint_payload(self):

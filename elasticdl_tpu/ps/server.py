@@ -79,7 +79,17 @@ class ParameterServer:
                     self.args.ps_id, version)
 
     def prepare(self):
-        self._server = grpc_utils.build_server(max_workers=64)
+        interceptors = None
+        if getattr(self.args, "rpc_delay_ms", 0) > 0:
+            # Bench rigs run worker and PS on one host; this emulates
+            # the cross-host wire latency the overlap path is built
+            # to hide (see bench_ps_wire.py).
+            interceptors = [grpc_utils.RpcDelayInterceptor(
+                self.args.rpc_delay_ms / 1000.0
+            )]
+        self._server = grpc_utils.build_server(
+            max_workers=64, interceptors=interceptors
+        )
         rpc.add_pserver_servicer(self.servicer, self._server)
         self.port = self._server.add_insecure_port(
             "[::]:%d" % self.args.port

@@ -118,7 +118,13 @@ class PserverServicer:
         vectors = self._params.pull_embedding_vectors(
             request.name, np.asarray(request.ids, np.int64)
         )
-        return tensor_codec.ndarray_to_pb(vectors)
+        # The master copy stays float32; the client may ask for a
+        # reduced-precision wire encoding (request.wire_dtype, e.g.
+        # "bfloat16") to halve the pull bandwidth — the codec upcasts
+        # transparently on decode.
+        return tensor_codec.ndarray_to_pb(
+            vectors, wire_dtype=request.wire_dtype or None
+        )
 
     @rpc_error_guard
     def push_gradients(self, request, _context=None):

@@ -60,6 +60,11 @@ def build_master_parser():
     parser.add_argument("--use_async", type=_str2bool, default=True)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    # Forwarded to PS workers (see worker parser for semantics).
+    parser.add_argument("--async_push_window", type=int, default=1)
+    parser.add_argument("--get_model_steps", type=int, default=1)
+    parser.add_argument("--ps_wire_dtype", default="float32",
+                        choices=["float32", "bfloat16"])
     parser.add_argument("--shuffle", type=_str2bool, default=False)
     parser.add_argument("--shuffle_shards", type=_str2bool, default=False)
     parser.add_argument("--max_task_retries", type=int, default=3)
@@ -103,6 +108,22 @@ def build_worker_parser():
     parser.add_argument("--use_async", type=_str2bool, default=True,
                         help="PS mode; sync (False) selects the atomic "
                              "prepare/commit gradient push")
+    parser.add_argument("--async_push_window", type=int, default=1,
+                        help="max gradient pushes in flight behind the "
+                             "compute (async PS jobs); 0 = serialized "
+                             "blocking push; ignored in sync mode, "
+                             "which stays strictly ordered")
+    parser.add_argument("--get_model_steps", type=int, default=1,
+                        help="pull dense params every N steps; each "
+                             "pull drains the push pipeline, so N > 1 "
+                             "is what lets the async push window "
+                             "actually overlap compute")
+    parser.add_argument("--ps_wire_dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="on-wire encoding for pushed gradients and "
+                             "pulled embedding rows; bfloat16 halves "
+                             "the PS bandwidth, the PS still "
+                             "accumulates in float32")
     return parser
 
 
@@ -129,6 +150,10 @@ def build_ps_parser():
                         help="HTTP observability port (/healthz "
                              "/status /metrics); 0 = any free port, "
                              "-1 (default) = disabled")
+    parser.add_argument("--rpc_delay_ms", type=float, default=0.0,
+                        help="benchmark aid: add fixed latency to every "
+                             "RPC to emulate a cross-host link on a "
+                             "single-host rig (0 = off)")
     return parser
 
 

@@ -7,6 +7,7 @@ must fit in one message.
 
 import functools
 import socket
+import time
 from concurrent import futures
 
 import grpc
@@ -62,10 +63,42 @@ def wait_for_channel_ready(channel, timeout=30):
     grpc.channel_ready_future(channel).result(timeout=timeout)
 
 
-def build_server(max_workers=64):
+class RpcDelayInterceptor(grpc.ServerInterceptor):
+    """Benchmark aid: adds a fixed per-RPC latency, emulating a
+    cross-host link when client and server share loopback (bench rigs).
+    The sleep runs on the handler thread, so concurrent RPCs are
+    delayed concurrently — like wire latency, not like a slow server."""
+
+    def __init__(self, delay_s):
+        self.delay_s = float(delay_s)
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if (
+            handler is None
+            or self.delay_s <= 0
+            or handler.unary_unary is None
+        ):
+            return handler
+        inner = handler.unary_unary
+        delay_s = self.delay_s
+
+        def delayed(request, context):
+            time.sleep(delay_s)
+            return inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            delayed,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+def build_server(max_workers=64, interceptors=None):
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=CHANNEL_OPTIONS,
+        interceptors=interceptors or (),
     )
 
 

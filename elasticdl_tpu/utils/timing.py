@@ -23,6 +23,17 @@ class Timing:
         self._totals = defaultdict(float)
         self._counts = defaultdict(int)
         self._starts = {}
+        self._events = defaultdict(int)
+
+    def bump(self, name, n=1):
+        """Count a discrete event (no duration) — e.g. how often an
+        async gradient push actually overlapped compute vs. blocked, or
+        embedding-prefetch hits vs. misses."""
+        if self._enabled:
+            self._events[name] += n
+
+    def counters(self):
+        return dict(self._events)
 
     def start(self, name):
         if self._enabled:
@@ -61,6 +72,8 @@ class Timing:
                     s["count"],
                     s["mean_s"],
                 )
+            for name, n in sorted(self._events.items()):
+                self._logger.info("counter[%s]: %d", name, n)
 
 
 @contextlib.contextmanager

@@ -70,13 +70,25 @@ def build_worker(args):
         from elasticdl_tpu.worker.ps_client import build_ps_client
         from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
 
-        ps_client = build_ps_client(args.ps_addrs)
+        ps_client = build_ps_client(
+            args.ps_addrs, wire_dtype=args.ps_wire_dtype,
+            # The pipelined trainer pushes from a background thread;
+            # give that traffic its own connections so it never convoys
+            # the foreground pulls.
+            dedicated_push_channels=(
+                args.use_async and args.async_push_window > 0
+            ),
+        )
         trainer = ParameterServerTrainer(
             spec, ps_client,
             batch_size=args.batch_size,
             master_client=mc,
             rng_seed=args.seed,
             atomic_sync=not args.use_async,
+            async_push_window=args.async_push_window,
+            # Every dense pull drains the push pipeline; a cadence > 1
+            # is what gives the async push room to overlap compute.
+            get_model_steps=args.get_model_steps,
         )
         return Worker(
             mc, reader, spec, trainer,

@@ -4,8 +4,16 @@ Parity with elasticdl/python/worker/ps_client.py:37-301: dense params route
 to a PS shard by name hash, embedding ids by ``id % N``; pulls/pushes fan
 out to all shards as concurrent gRPC futures; duplicate embedding ids are
 merged before pushing.
+
+``wire_dtype`` ("bfloat16") compresses every float32 tensor this client
+puts on the wire — pushed gradients and pulled embedding rows — to half
+the bandwidth; the PS keeps its master copies and accumulation in float32
+(the codec upcasts transparently on decode).  ``wire_stats`` counts the
+actual serialized bytes per direction so benchmarks and the status page
+can report bytes-on-wire without a proxy.
 """
 
+import threading
 import uuid
 
 import numpy as np
@@ -15,22 +23,74 @@ from elasticdl_tpu.proto.rpc import PServerStub
 from elasticdl_tpu.utils import grpc_utils, hashing, tensor_codec
 
 
-def build_ps_client(ps_addrs):
-    """ps_addrs: comma-separated or list of host:port."""
+def build_ps_client(ps_addrs, wire_dtype=None,
+                    dedicated_push_channels=False):
+    """ps_addrs: comma-separated or list of host:port.
+
+    ``dedicated_push_channels`` opens a second connection per shard for
+    gradient pushes — required for the pipelined trainer, where a
+    background push sharing the pull connection's completion queue
+    convoys every foreground pull behind it."""
     if isinstance(ps_addrs, str):
         ps_addrs = [a for a in ps_addrs.split(",") if a]
-    channels = []
-    for addr in ps_addrs:
-        channel = grpc_utils.build_channel(addr)
-        grpc_utils.wait_for_channel_ready(channel)
-        channels.append(channel)
-    return PSClient(channels)
+
+    def connect():
+        channels = []
+        for addr in ps_addrs:
+            channel = grpc_utils.build_channel(addr)
+            grpc_utils.wait_for_channel_ready(channel)
+            channels.append(channel)
+        return channels
+
+    return PSClient(
+        connect(), wire_dtype=wire_dtype,
+        push_channels=connect() if dedicated_push_channels else None,
+    )
 
 
 class PSClient:
-    def __init__(self, channels):
+    def __init__(self, channels, wire_dtype=None, push_channels=None):
         self._stubs = [PServerStub(c) for c in channels]
+        # Optional dedicated connections for the (possibly background)
+        # gradient push, so bulk push traffic never contends with the
+        # latency-sensitive pull path on one HTTP/2 connection.
+        self._push_stubs = (
+            [PServerStub(c) for c in push_channels]
+            if push_channels else self._stubs
+        )
         self.num_ps = len(self._stubs)
+        if push_channels is not None and len(push_channels) != self.num_ps:
+            raise ValueError(
+                "push_channels must match channels per shard (%d != %d)"
+                % (len(push_channels), self.num_ps)
+            )
+        if wire_dtype in ("", "float32"):
+            wire_dtype = None
+        if wire_dtype is not None and wire_dtype not in (
+            tensor_codec.WIRE_DTYPES
+        ):
+            raise ValueError(
+                "unsupported wire_dtype %r (have float32, %s)"
+                % (wire_dtype, ", ".join(tensor_codec.WIRE_DTYPES))
+            )
+        self.wire_dtype = wire_dtype
+        # table name -> row dim, learned from the embedding infos this
+        # client pushes; lets empty pulls keep their (0, dim) shape.
+        self._emb_dims = {}
+        # Serialized payload bytes per direction.  Bumped from the step
+        # thread, the push executor, AND the prefetch pool concurrently,
+        # so every += runs under the stats lock (these are the bench's
+        # bytes-on-wire artifact — lost updates would skew it).
+        self._stats_lock = threading.Lock()
+        self.wire_stats = {
+            "push_gradient_bytes": 0,
+            "pull_dense_bytes": 0,
+            "pull_embedding_bytes": 0,
+        }
+
+    def _count_bytes(self, key, n):
+        with self._stats_lock:
+            self.wire_stats[key] += n
 
     # -- partitioning -------------------------------------------------------
 
@@ -43,6 +103,7 @@ class PSClient:
     # -- model init ---------------------------------------------------------
 
     def push_model(self, dense, embedding_infos=None, version=0):
+        self._remember_dims(embedding_infos)
         buckets = self.partition_dense(dense.keys())
         futures = []
         for shard, names in enumerate(buckets):
@@ -56,6 +117,7 @@ class PSClient:
             f.result()
 
     def push_embedding_table_infos(self, infos):
+        self._remember_dims(infos)
         model = tensor_codec.model_to_pb(infos=infos)
         futures = [
             stub.push_embedding_table_infos.future(model)
@@ -63,6 +125,10 @@ class PSClient:
         ]
         for f in futures:
             f.result()
+
+    def _remember_dims(self, infos):
+        for info in infos or []:
+            self._emb_dims[info["name"]] = int(info["dim"])
 
     # -- dense --------------------------------------------------------------
 
@@ -77,6 +143,7 @@ class PSClient:
         server_version = 0
         for f in futures:
             res = f.result()
+            self._count_bytes("pull_dense_bytes", res.ByteSize())
             initialized = initialized and res.initialized
             server_version = max(server_version, res.version)
             for name, t in res.dense_parameters.items():
@@ -85,15 +152,23 @@ class PSClient:
 
     # -- embeddings ---------------------------------------------------------
 
-    def pull_embedding_vectors(self, name, ids):
-        """ids: int64 [n]; returns [n, dim] rows in input order."""
+    def pull_embedding_vectors(self, name, ids, dim=None):
+        """ids: int64 [n]; returns [n, dim] rows in input order.
+
+        ``dim`` threads the table's row dim through for the empty-ids
+        case; omitted, it falls back to the infos this client pushed."""
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
-            return np.zeros((0, 0), np.float32)
+            return np.zeros(
+                (0, int(dim) if dim else self._emb_dims.get(name, 0)),
+                np.float32,
+            )
         buckets = hashing.scatter_ids(ids, self.num_ps)
         futures = {}
         for shard, positions in buckets.items():
-            req = pb.PullEmbeddingVectorsRequest(name=name)
+            req = pb.PullEmbeddingVectorsRequest(
+                name=name, wire_dtype=self.wire_dtype or ""
+            )
             # .tolist() keeps the proto extend in C instead of a
             # 300k-call python genexpr (profiled hot path).
             req.ids.extend(ids[positions].tolist())
@@ -102,7 +177,9 @@ class PSClient:
             )
         out = None
         for shard, (positions, future) in futures.items():
-            rows = tensor_codec.pb_to_ndarray(future.result())
+            res = future.result()
+            self._count_bytes("pull_embedding_bytes", res.ByteSize())
+            rows = tensor_codec.pb_to_ndarray(res)
             if out is None:
                 out = np.empty((ids.size, rows.shape[1]), np.float32)
             out[positions] = rows
@@ -132,11 +209,15 @@ class PSClient:
                 dense=shard_dense[shard],
                 embeddings=shard_emb[shard],
                 version=version,
+                wire_dtype=self.wire_dtype,
             )
             req = pb.PushGradientsRequest(
                 gradients=model, learning_rate=learning_rate
             )
-            futures.append(self._stubs[shard].push_gradients.future(req))
+            self._count_bytes("push_gradient_bytes", req.ByteSize())
+            futures.append(
+                self._push_stubs[shard].push_gradients.future(req)
+            )
         accepted = True
         max_version = 0
         for f in futures:
@@ -180,11 +261,13 @@ class PSClient:
                 dense=shard_dense[shard],
                 embeddings=shard_emb[shard],
                 version=version,
+                wire_dtype=self.wire_dtype,
             )
             req = pb.PrepareGradientsRequest(
                 txn_id=txn_id, gradients=model,
                 learning_rate=learning_rate,
             )
+            self._count_bytes("push_gradient_bytes", req.ByteSize())
             prepare_futures.append(
                 self._stubs[shard].prepare_gradients.future(req)
             )

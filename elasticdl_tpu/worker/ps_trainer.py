@@ -17,18 +17,34 @@ Step shape:
   4. host: push dense grads + per-table (grad_rows[:n_unique], ids) to PS
   5. a rejected push (sync mode staleness) raises -> the worker's
      minibatch retry loop re-pulls and retries
+
+Overlapped hot path (``async_push_window`` > 0, async jobs): step 4 runs
+on a single-thread background executor so step N's push overlaps step
+N+1's embedding pull and jitted compute — classic bounded-staleness
+pipelining (at most ``async_push_window`` pushes in flight; the pipeline
+drains before exceeding it).  A push the PS rejects surfaces as
+``GradientsRejected`` on a later ``train_minibatch`` after the pipeline
+drains, and the worker's existing re-pull/retry loop takes over.  Sync
+jobs (``atomic_sync=True``) keep the strictly ordered blocking
+prepare/commit push — the 2PC protocol's staleness window is the whole
+point there, so nothing may ride ahead of it.  Independently,
+``prefetch_embeddings`` lets the worker loop start the NEXT batch's
+embedding pulls while the current device step runs (composing with
+``data/parallel_reader.prefetch_batches``, which overlaps read/decode the
+same way one stage earlier).
 """
 
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from elasticdl_tpu.utils.logging import get_logger
-from elasticdl_tpu.utils.pytree import (
-    flatten_with_names,
-    to_numpy,
-    unflatten_from_names,
-)
+from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 from elasticdl_tpu.utils.timing import Timing
 from elasticdl_tpu.worker.collective_trainer import _pad_batch
 from elasticdl_tpu.worker.trainer import Trainer
@@ -36,6 +52,11 @@ from elasticdl_tpu.worker.trainer import Trainer
 logger = get_logger(__name__)
 
 IDS_KEY = "__ids__"
+
+# Prefetched embedding pulls kept live at once; the worker loop runs one
+# batch ahead so 2 per table is already generous — the cap only guards
+# against a caller prefetching far past what it trains.
+PREFETCH_CACHE_MAX = 8
 
 
 class GradientsRejected(RuntimeError):
@@ -53,6 +74,7 @@ class ParameterServerTrainer(Trainer):
         rng_seed=0,
         learning_rate=0.0,
         atomic_sync=False,
+        async_push_window=0,
     ):
         self._spec = spec
         self._ps = ps_client
@@ -63,13 +85,37 @@ class ParameterServerTrainer(Trainer):
         # Sync jobs with num_ps > 1 need the prepare/commit push so one
         # shard's stale-reject aborts the minibatch on every shard.
         self._atomic_sync = atomic_sync
+        # Max gradient pushes in flight behind the compute (0 =
+        # serialized blocking push, the pre-pipeline behavior).
+        # atomic_sync overrides this to stay strictly ordered.
+        self._push_window = 0 if atomic_sync else max(
+            0, int(async_push_window)
+        )
         self.timing = Timing(logger=logger)
+
+        # Single worker thread => pushes leave in submission order
+        # (double-buffered, not reordered); created eagerly so the
+        # shutdown story lives in close() regardless of window config.
+        self._push_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ps-push"
+        )
+        self._push_inflight = deque()   # futures, oldest first
+        self._prefetch_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="emb-prefetch"
+        )
+        self._prefetched = {}   # (table, uniq-ids bytes) -> Future[rows]
+        self._prefetch_active = False
 
         self._params = spec.init_fn(jax.random.PRNGKey(rng_seed))
         self._emb_dims = {
             info["name"]: info["dim"]
             for info in spec.ps_embedding_infos
         }
+        # The param pytree's structure never changes, so flatten once:
+        # every later dense merge reuses the name order + treedef
+        # instead of re-walking the tree with path keys twice per pull.
+        named, self._treedef = flatten_with_names(to_numpy(self._params))
+        self._flat_names = list(named)
         self._version = 0
         self._steps = 0
         self._grad_step = None
@@ -115,13 +161,153 @@ class ParameterServerTrainer(Trainer):
         """Merge a (possibly partial) dense pull into local params — a
         freshly restored shard can lag the others and return only its
         slice, or nothing at all."""
-        named, _ = flatten_with_names(to_numpy(self._params))
-        named.update(dense)
-        self._params = unflatten_from_names(
-            to_numpy(self._params), named
+        leaves = jax.tree_util.tree_leaves(to_numpy(self._params))
+        new_leaves = []
+        for name, leaf in zip(self._flat_names, leaves):
+            arr = dense.get(name)
+            if arr is None:
+                new_leaves.append(leaf)
+            else:
+                new_leaves.append(
+                    np.asarray(arr).reshape(np.shape(leaf)).astype(
+                        np.asarray(leaf).dtype
+                    )
+                )
+        self._params = jax.tree_util.tree_unflatten(
+            self._treedef, new_leaves
         )
 
+    # -- async push pipeline ------------------------------------------------
+
+    def _submit_push(self, param_grads, emb_grads, push_info):
+        """Queue the push behind the compute; bounded staleness — once
+        ``async_push_window`` pushes are in flight, block on the oldest
+        (that wait is the pipeline's backpressure).
+
+        Takes the RAW grad pytrees: materializing the async jax arrays,
+        flattening, and serializing all happen on the push thread, so
+        the step thread never blocks on them (deferred gradient
+        materialization — the device step is still running when this
+        returns)."""
+        while len(self._push_inflight) >= self._push_window:
+            self.timing.bump("push_window_stall")
+            self._drain_oldest_push()
+        version = self._version
+        learning_rate = self._learning_rate
+
+        def push():
+            named_grads, _ = flatten_with_names(to_numpy(param_grads))
+            emb_push = {}
+            for table, (uniq_ids, n_uniq) in push_info.items():
+                emb_push[table] = (
+                    np.asarray(emb_grads[table])[:n_uniq], uniq_ids
+                )
+            # The blocking path leans on the worker's minibatch retry
+            # loop to ride out a relaunching PS shard; by the time an
+            # async push fails, its minibatch was already reported
+            # done, so the retry must live HERE or the gradient is
+            # dropped.  Same double-apply-on-lost-response risk as the
+            # worker-level retry — bounded, never silent.
+            for attempt in range(5):
+                try:
+                    return self._ps.push_gradients(
+                        named_grads, emb_push,
+                        version=version, learning_rate=learning_rate,
+                    )
+                except grpc.RpcError as e:
+                    logger.warning(
+                        "async push failed (attempt %d): %s",
+                        attempt + 1, e,
+                    )
+                    self.timing.bump("push_rpc_retry")
+                    time.sleep(min(0.1 * (2 ** attempt), 3.0))
+            return self._ps.push_gradients(
+                named_grads, emb_push,
+                version=version, learning_rate=learning_rate,
+            )
+
+        self._push_inflight.append(self._push_pool.submit(push))
+        self.timing.bump("push_async_submitted")
+
+    def _drain_oldest_push(self):
+        future = self._push_inflight.popleft()
+        with self.timing.timeit("push_drain_wait"):
+            accepted, _ = future.result()
+        if not accepted:
+            # Empty the pipeline before surfacing the reject: the
+            # worker's retry loop must restart from a known-clean state
+            # (pending pushes against the stale version would only be
+            # rejected too).
+            self.drain_pushes()
+            self._pull_dense()
+            raise GradientsRejected(
+                "stale gradients at version %d" % self._version
+            )
+
+    def drain_pushes(self):
+        """Block until no gradient push is in flight.  Rejects and RPC
+        errors are counted and logged, not raised — drain callers
+        (reject recovery, eval, close) need the pipeline empty above
+        all; the next training push surfaces a persistent failure."""
+        while self._push_inflight:
+            future = self._push_inflight.popleft()
+            try:
+                accepted, _ = future.result()
+            except Exception as e:  # noqa: BLE001 — see docstring
+                logger.warning("async gradient push failed: %s", e)
+                accepted = False
+            if not accepted:
+                self.timing.bump("push_drain_dropped")
+
+    def close(self):
+        """Drain the pipeline and stop the background threads; the
+        trainer stays usable for eval/export afterwards (pulls are
+        synchronous), but not for pipelined training."""
+        self.drain_pushes()
+        self._prefetched.clear()
+        self._push_pool.shutdown(wait=True)
+        self._prefetch_pool.shutdown(wait=True)
+
     # -- embedding plumbing -------------------------------------------------
+
+    def _padded_unique_ids(self, ids):
+        """Pad an id array to the static batch size the way _pad_batch
+        will (zero rows -> id 0), then unique — prefetch and prepare
+        must derive the SAME key for the same logical batch."""
+        ids = np.asarray(ids, dtype=np.int64)
+        n = ids.shape[0]
+        if n < self._batch_size:
+            pad = [(0, self._batch_size - n)] + [(0, 0)] * (ids.ndim - 1)
+            ids = np.pad(ids, pad)
+        return ids, np.unique(ids.reshape(-1))
+
+    def prefetch_embeddings(self, features):
+        """Overlap the NEXT batch's embedding pulls with the current
+        device step: the worker loop calls this one batch ahead, the
+        pulls run on a small background pool, and _prepare_embeddings
+        picks the finished rows up by id-set key.
+
+        No-op outside pipelined mode: a prefetched row set predates the
+        current batch's push, which is exactly the reordering
+        atomic_sync (and an explicit window of 0) promises not to do."""
+        if self._push_window == 0:
+            return
+        if not isinstance(features, dict) or IDS_KEY not in features:
+            return
+        self._prefetch_active = True
+        for table, ids in features[IDS_KEY].items():
+            _, uniq = self._padded_unique_ids(ids)
+            key = (table, uniq.tobytes())
+            if key in self._prefetched:
+                continue
+            while len(self._prefetched) >= PREFETCH_CACHE_MAX:
+                # Drop the oldest entry (insertion order); its pull
+                # just becomes an unused background fetch.
+                self._prefetched.pop(next(iter(self._prefetched)))
+            self._prefetched[key] = self._prefetch_pool.submit(
+                self._ps.pull_embedding_vectors, table, uniq,
+                self._emb_dims[table],
+            )
 
     def _prepare_embeddings(self, features):
         """Extract ids, pull rows, return (clean_features, emb_inputs,
@@ -140,8 +326,19 @@ class ParameterServerTrainer(Trainer):
             # Pull only the unique rows; pad host-side to the flat id
             # count so the jitted step sees one static shape per batch
             # size without inflating the gRPC payload.
+            prefetched = self._prefetched.pop(
+                (table, uniq.tobytes()), None
+            )
             with self.timing.timeit("pull_embedding"):
-                rows = self._ps.pull_embedding_vectors(table, uniq)
+                if prefetched is not None:
+                    rows = prefetched.result()
+                    self.timing.bump("prefetch_hit")
+                else:
+                    rows = self._ps.pull_embedding_vectors(
+                        table, uniq, dim=self._emb_dims[table]
+                    )
+                    if self._prefetch_active:
+                        self.timing.bump("prefetch_miss")
             padded_rows = np.zeros(
                 (flat.size, self._emb_dims[table]), np.float32
             )
@@ -203,6 +400,12 @@ class ParameterServerTrainer(Trainer):
 
     def train_minibatch(self, features, labels):
         if self._steps % self._get_model_steps == 0:
+            # Pipelined mode: drain in-flight pushes first.  A pull
+            # racing a push convoys on the servicer lock behind the
+            # apply anyway, but returns the PRE-push state; draining
+            # makes every dense pull observe all of this worker's own
+            # pushes, so staleness stays bounded by one pull cadence.
+            self.drain_pushes()
             self._pull_dense()
         # Pad BEFORE preparing embeddings so id-array shapes are static
         # across partial batches (padding rows look up id 0 with weight 0).
@@ -227,20 +430,28 @@ class ParameterServerTrainer(Trainer):
                 self._params, emb_inputs, features, labels, weights
             )
         with self.timing.timeit("report_gradient"):
-            named_grads, _ = flatten_with_names(to_numpy(param_grads))
-            emb_push = {}
-            for table, (uniq_ids, n_uniq) in push_info.items():
-                rows = np.asarray(emb_grads[table])[:n_uniq]
-                emb_push[table] = (rows, uniq_ids)
-            push = (
-                self._ps.push_gradients_atomic if self._atomic_sync
-                else self._ps.push_gradients
-            )
-            accepted, version = push(
-                named_grads, emb_push,
-                version=self._version,
-                learning_rate=self._learning_rate,
-            )
+            if self._push_window > 0:
+                # Pipelined: this step's push (including grad
+                # materialization + serialization) overlaps the next
+                # step's pulls and compute.  A reject surfaces from a
+                # later _submit_push/drain after the pipeline empties.
+                self._submit_push(param_grads, emb_grads, push_info)
+                accepted, version = True, self._version
+            else:
+                named_grads, _ = flatten_with_names(to_numpy(param_grads))
+                emb_push = {}
+                for table, (uniq_ids, n_uniq) in push_info.items():
+                    rows = np.asarray(emb_grads[table])[:n_uniq]
+                    emb_push[table] = (rows, uniq_ids)
+                push = (
+                    self._ps.push_gradients_atomic if self._atomic_sync
+                    else self._ps.push_gradients
+                )
+                accepted, version = push(
+                    named_grads, emb_push,
+                    version=self._version,
+                    learning_rate=self._learning_rate,
+                )
         if not accepted:
             self._pull_dense()
             raise GradientsRejected(
@@ -258,6 +469,9 @@ class ParameterServerTrainer(Trainer):
         return float(loss), version
 
     def evaluate_minibatch(self, features, labels):
+        # Flush pending pushes so evaluation reads a PS state that
+        # includes everything this worker trained.
+        self.drain_pushes()
         n = jax.tree_util.tree_leaves(features)[0].shape[0]
         (features, labels), _ = _pad_batch(
             (features, labels), self._batch_size

@@ -137,18 +137,31 @@ class Worker:
     def _train_task(self, task):
         from elasticdl_tpu.data.parallel_reader import prefetch_batches
 
+        # PS trainers can start the NEXT batch's embedding pulls while
+        # the current device step runs; the one-batch lookahead below
+        # feeds that prefetcher (it composes with prefetch_batches,
+        # which overlaps read/decode/feed one stage earlier).
+        prefetch_embeddings = getattr(
+            self._trainer, "prefetch_embeddings", None
+        )
         with self.timing.timeit("task_process"):
             try:
                 # Prefetch so host-side read/decode/feed overlaps the
                 # device step (the input-pipeline half of keeping the
                 # MXU busy); producer errors re-raise here where the
                 # task-failure reporting lives.
-                for features, labels, count in prefetch_batches(
+                batches = prefetch_batches(
                     self._data_service.batch_stream(
                         task, self._batch_size
                     ),
                     depth=2,
-                ):
+                )
+                pending = next(batches, None)
+                while pending is not None:
+                    features, labels, count = pending
+                    pending = next(batches, None)
+                    if pending is not None and prefetch_embeddings:
+                        prefetch_embeddings(pending[0])
                     self._process_minibatch(features, labels)
                     self._shard_service.report_batch_done(count)
                     if self._preempt_requested:
@@ -282,6 +295,13 @@ class Worker:
                     # mask the preemption exit path
                     logger.error("preemption checkpoint failed: %s", e)
         finally:
+            if hasattr(self._trainer, "close"):
+                # Drain any in-flight async gradient pushes and stop
+                # the trainer's background threads before reporting.
+                try:
+                    self._trainer.close()
+                except Exception as e:  # noqa: BLE001 — best effort
+                    logger.warning("trainer close failed: %s", e)
             if self._join_rendezvous:
                 self._mc.report_train_loop_status(pb.LOOP_END)
             self.timing.report()

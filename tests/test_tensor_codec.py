@@ -50,3 +50,93 @@ def test_model_pb_roundtrip():
     np.testing.assert_array_equal(d2["w"], dense["w"])
     np.testing.assert_array_equal(e2["table"][1], [1, 9])
     assert i2[0]["name"] == "table" and i2[0]["dim"] == 3
+
+
+def test_wire_dtype_bf16_roundtrip_upcasts_to_f32():
+    pytest.importorskip("ml_dtypes")
+    a = np.random.default_rng(0).standard_normal((5, 7)).astype(
+        np.float32
+    )
+    t = tensor_codec.ndarray_to_pb(a, wire_dtype="bfloat16")
+    assert t.dtype == "float32" and t.wire_dtype == "bfloat16"
+    assert len(t.content) == a.size * 2  # half the f32 bytes
+    b = tensor_codec.pb_to_ndarray(t)
+    assert b.dtype == np.float32
+    np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-2)
+
+
+def test_wire_dtype_ignored_for_non_f32_and_cleared_on_reuse():
+    pytest.importorskip("ml_dtypes")
+    ints = np.arange(4, dtype=np.int64)
+    t = tensor_codec.ndarray_to_pb(ints, wire_dtype="bfloat16")
+    assert t.wire_dtype == ""  # ids/ints never downcast
+    np.testing.assert_array_equal(tensor_codec.pb_to_ndarray(t), ints)
+    # reusing a message that previously carried bf16 must clear the
+    # wire marker, or the f32 payload would be misdecoded
+    reuse = tensor_codec.ndarray_to_pb(
+        np.ones(3, np.float32), wire_dtype="bfloat16"
+    )
+    tensor_codec.ndarray_to_pb(np.ones(3, np.float32), out=reuse)
+    assert reuse.wire_dtype == ""
+    assert tensor_codec.pb_to_ndarray(reuse).dtype == np.float32
+
+
+def test_model_pb_wire_dtype_compresses_floats_not_ids():
+    pytest.importorskip("ml_dtypes")
+    dense = {"w": np.random.rand(8, 4).astype(np.float32)}
+    emb = {"t": (np.random.rand(3, 4).astype(np.float32),
+                 np.array([5, 1, 9], np.int64))}
+    m = tensor_codec.model_to_pb(
+        dense=dense, embeddings=emb, wire_dtype="bfloat16"
+    )
+    assert m.dense_parameters["w"].wire_dtype == "bfloat16"
+    assert m.embedding_tables["t"].values.wire_dtype == "bfloat16"
+    d2, e2, _, _ = tensor_codec.pb_to_model(m)
+    assert d2["w"].dtype == np.float32
+    values, ids = e2["t"]
+    assert values.dtype == np.float32
+    np.testing.assert_array_equal(ids, [5, 1, 9])  # ids exact
+    np.testing.assert_allclose(d2["w"], dense["w"], atol=1e-2)
+
+
+def test_merge_indexed_slices_matches_add_at_reference():
+    rng = np.random.default_rng(7)
+    for n, vocab in [(0, 10), (1, 10), (300, 40), (2000, 5000)]:
+        ids = rng.integers(0, vocab, size=n).astype(np.int64)
+        values = rng.standard_normal((n, 6)).astype(np.float32)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        ref = np.zeros((uniq.size, 6), np.float32)
+        np.add.at(ref, inverse, values)
+        merged, out_ids = tensor_codec.merge_indexed_slices(values, ids)
+        np.testing.assert_array_equal(out_ids, uniq)
+        np.testing.assert_allclose(merged, ref, rtol=1e-5, atol=1e-6)
+        assert merged.dtype == np.float32
+
+
+def test_merge_indexed_slices_unique_fast_paths():
+    values = np.arange(6, dtype=np.float32).reshape(3, 2)
+    # pre-sorted unique ids: pass-through, no copy
+    merged, uniq = tensor_codec.merge_indexed_slices(values, [2, 5, 9])
+    assert merged is values
+    np.testing.assert_array_equal(uniq, [2, 5, 9])
+    # unsorted unique ids: rows gathered into sorted-id order
+    merged, uniq = tensor_codec.merge_indexed_slices(values, [9, 2, 5])
+    np.testing.assert_array_equal(uniq, [2, 5, 9])
+    np.testing.assert_allclose(merged, values[[1, 2, 0]])
+
+
+def test_timing_counters():
+    from elasticdl_tpu.utils.timing import Timing
+
+    timing = Timing()
+    timing.bump("prefetch_hit")
+    timing.bump("prefetch_hit", 2)
+    timing.bump("push_window_stall")
+    assert timing.counters() == {
+        "prefetch_hit": 3, "push_window_stall": 1,
+    }
+    timing.reset()
+    assert timing.counters() == {}
+    disabled = Timing(enabled=False)
+    disabled.bump("x")
+    assert disabled.counters() == {}
