@@ -276,6 +276,31 @@ def export_servable(export_dir, apply_fn, params, example_input,
     if emb_quantized:
         fmt = "int8-emb+" + fmt
     quantized = quantized + emb_quantized  # manifest lists both kinds
+    # Output signature straight from the exported avals (None where the
+    # dim is symbolic): the serving batcher needs to know which OUTPUT
+    # leaves carry the batch dim to slice a padded batch back per
+    # request — a shape heuristic alone would mis-slice a fixed-size
+    # aux output whose leading dim happens to equal a pad bucket.
+    def _plain(tree):
+        if isinstance(tree, dict):
+            return {k: _plain(v) for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            return [_plain(v) for v in tree]
+        return tree
+
+    try:
+        output_signature = _plain(jax.tree_util.tree_unflatten(
+            exported.out_tree,
+            [{"shape": [d if isinstance(d, int) else None
+                        for d in aval.shape],
+              "dtype": str(aval.dtype)}
+             for aval in exported.out_avals],
+        ))
+    except Exception as e:  # noqa: BLE001 — an exotic output pytree
+        # (custom nodes) must not break the export; the batcher falls
+        # back to its shape heuristic when the signature is absent.
+        logger.warning("output signature not recorded: %s", e)
+        output_signature = None
     manifest = {
         "format": fmt,
         "model_name": model_name,
@@ -286,6 +311,7 @@ def export_servable(export_dir, apply_fn, params, example_input,
         "parameters": sorted(flat),
         "embedding_tables": sorted(table_names),
         "input_signature": signature,
+        "output_signature": output_signature,
         "loader": "elasticdl_tpu.serving.loader:load_servable",
     }
     with open(os.path.join(export_dir, "manifest.json"), "w") as f:

@@ -123,6 +123,31 @@ class ServableModel:
         or pytree matching manifest['input_signature'])."""
         return self.exported.call(self.params, inputs)
 
+    def dummy_inputs(self, batch_size):
+        """Zero-filled inputs matching ``manifest['input_signature']``
+        with every free (None) leading dim set to ``batch_size``.
+
+        This is the warmup payload for shape-bucketed serving: each
+        distinct concrete batch shape costs the export one XLA compile,
+        so the server pre-runs ``predict(dummy_inputs(b))`` for each pad
+        bucket ``b`` at load / hot-swap time and no live request ever
+        pays that compile.
+        """
+        def build(sig):
+            if (isinstance(sig, dict)
+                    and isinstance(sig.get("shape"), (list, tuple))
+                    and isinstance(sig.get("dtype"), str)):
+                shape = [batch_size if d is None else d
+                         for d in sig["shape"]]
+                return np.zeros(shape, np.dtype(sig["dtype"]))
+            if isinstance(sig, dict):
+                return {k: build(v) for k, v in sig.items()}
+            if isinstance(sig, (list, tuple)):
+                return [build(v) for v in sig]
+            raise ValueError(
+                "input_signature node %r has no shape/dtype" % (sig,))
+        return build(self.manifest.get("input_signature"))
+
     def lookup_embedding(self, table, ids, default=0.0):
         """Host-side embedding lookup for PS-trained tables.
 

@@ -8,19 +8,26 @@ TF Serving exposes, so clients migrating from the reference keep their
 request shape:
 
   GET  /v1/models/<name>            -> model metadata (manifest)
+  GET  /statz                       -> batching/queue/latency counters
   POST /v1/models/<name>:predict    -> {"predictions": [...]}
        body {"instances": [...]}          batched single-input models
        body {"inputs": {name: [...]}}     dict-input models
   POST /v1/models/<name>:lookup     -> {"vectors": [...]}
        body {"table": t, "ids": [...]}    PS-trained embedding tables
 
-Stdlib-only HTTP (ThreadingHTTPServer); jax is needed only to execute
-the StableHLO — the loader stays framework-free.
+Stdlib-only HTTP (ThreadingHTTPServer, HTTP/1.1 keep-alive); jax is
+needed only to execute the StableHLO — the loader stays framework-free.
+
+Under load the hot path is the dynamic micro-batcher
+(``serving/batcher.py``): request threads marshal and enqueue, one
+executor thread per model coalesces concurrent requests into bucketed
+padded batches and runs a single ``predict`` — see that module and
+docs/serving.md.  ``--max_batch_size 1`` (or ``--enable_batching
+false``) restores the serialized per-request execution-lock path.
 
 Run: python -m elasticdl_tpu.serving.server --export_dir D [--port P]
 """
 
-import argparse
 import json
 import os
 import threading
@@ -29,11 +36,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from elasticdl_tpu.serving.batcher import (
+    BatchConfig,
+    ModelBatcher,
+    batch_plan,
+    is_leaf_signature,
+)
 from elasticdl_tpu.serving.loader import (
     load_servable,
     resolve_export_dir,
 )
+from elasticdl_tpu.utils.args import build_serving_parser
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.timing import Timing
 
 logger = get_logger(__name__)
 
@@ -45,13 +60,7 @@ def _leaf_dtypes(signature):
     FLAT dict of arrays ({"inputs": {name: ...}}); deeper pytree inputs
     need the Python loader directly.
     """
-    if (isinstance(signature, dict)
-            and isinstance(signature.get("shape"), (list, tuple))
-            and isinstance(signature.get("dtype"), str)):
-        # The leaf schema itself ({"shape": [...], "dtype": "..."}) —
-        # key presence alone is not enough: a dict-INPUT model whose
-        # feature names happen to include "shape"/"dtype" must not be
-        # misclassified as single-input.
+    if is_leaf_signature(signature):
         return {None: signature["dtype"]}
     if isinstance(signature, dict):
         return {
@@ -84,7 +93,8 @@ class ModelEndpoint:
     old model and later ones see the new one.
     """
 
-    def __init__(self, export_dir, name=None, poll_interval=2.0):
+    def __init__(self, export_dir, name=None, poll_interval=2.0,
+                 batching=None):
         self.export_dir = export_dir
         self.poll_interval = poll_interval
         self.model = load_servable(export_dir)
@@ -100,29 +110,109 @@ class ModelEndpoint:
         self._dtypes = _leaf_dtypes(
             self.model.manifest.get("input_signature", {})
         )
-        # (model, dtypes) as ONE tuple: a single attribute assignment is
-        # atomic, so a request never marshals with one version's dtypes
-        # and executes another version's model.
-        self._active = (self.model, self._dtypes)
+        # Batching config (serving/batcher.BatchConfig) — None, or a
+        # disabled config (max_batch_size 1), keeps the original
+        # serialized per-request execution-lock path EXACTLY.
+        self._batching = batching if (
+            batching is not None and batching.enabled) else None
+        self.timing = Timing()
+        plan = (batch_plan(self.model.manifest)
+                if self._batching is not None else None)
+        # (model, dtypes, plan) as ONE tuple: a single attribute
+        # assignment is atomic, so a request never marshals with one
+        # version's dtypes and executes another version's model.
+        self._active = (self.model, self._dtypes, plan)
         self._lock = threading.Lock()  # jax.export call is not
         # documented thread-safe; serialize execution, marshal outside
         self._reload_lock = threading.Lock()  # scan/load/swap critical
         # section — never held during predict execution
+        self._batcher = None
+        self._reload_thread = None
+        if self._batching is not None:
+            self._warm_buckets(self.model, plan)
+            self._batcher = ModelBatcher(
+                self._batching, reload_fn=self.maybe_reload,
+                execute_lock=self._lock, timing=self.timing,
+                name=self.name)
+
+    def _snapshot(self):
+        """THE unlocked read of the atomic ``(model, dtypes, plan)``
+        triple — every consumer (predict, lookup, metadata, stats)
+        routes through here, so a hot-swap can never interleave one
+        version's manifest/dtypes with another version's weights."""
+        return self._active
+
+    def _warm_buckets(self, model, plan):
+        """Pre-run ``predict`` at every pad bucket so the export's
+        per-shape XLA compiles happen NOW — at load / hot-swap time,
+        before the model takes traffic — and no live request pays a
+        cold compile.  Called on the fresh model BEFORE it is swapped
+        in, so the warm old version keeps serving meanwhile."""
+        if plan is None or not self._batching.warm:
+            return
+        for bucket in self._batching.pad_buckets:
+            try:
+                # Per-bucket lock acquisition: warmup may run on a
+                # request thread (a metadata() reload) while the
+                # executor serves the OLD model — exported.call is not
+                # documented thread-safe, so even different-model
+                # predicts serialize; live traffic interleaves between
+                # bucket warms rather than stalling for all of them.
+                with self.timing.timeit("batcher.warmup"), self._lock:
+                    model.predict(model.dummy_inputs(bucket))
+            except Exception as e:  # noqa: BLE001 — a model whose
+                # zero-input crashes still serves real traffic; it just
+                # pays its compiles lazily.
+                logger.warning("bucket-%d warmup failed for %r: %s",
+                               bucket, self.name, e)
+                return
+        self.timing.bump("batcher.warmed_models")
+
+    def close(self):
+        """Stop the batcher executor thread (pending requests fail
+        fast); the endpoint itself holds no other resources."""
+        if self._batcher is not None:
+            self._batcher.close()
 
     def maybe_reload(self):
         """Hot-swap to a newer complete version, if one has appeared.
 
         The steady-state cost is ONE listdir per poll_interval
         (resolve_export_dir); the full servable load happens only when
-        the resolved dir actually changed.  The whole scan/load/swap
-        runs under a dedicated reload lock so concurrent request
-        threads can neither duplicate the load nor swap versions out
-        of order (the execution lock stays free for predicts on the
-        old model while a new one loads)."""
+        the resolved dir actually changed.  On the serialized path the
+        scan/load/swap runs synchronously on the calling request
+        thread, as it always has.  With batching enabled the caller is
+        the batcher executor (or a metadata request) — neither may
+        stall the admission queue behind a servable load plus bucket
+        warmup — so the heavy work runs on a short-lived background
+        thread and the new version publishes (atomically, warm) when
+        ready; in-flight and in-queue requests finish on the model
+        they were admitted under either way."""
         if not self._versioned:
             return
         if time.monotonic() - self._last_scan < self.poll_interval:
             return
+        if self._batcher is None:
+            self._scan_and_swap()
+            return
+        thread = self._reload_thread
+        if thread is not None and thread.is_alive():
+            return
+        # Benign race: two threads may both spawn; _scan_and_swap
+        # itself is serialized by _reload_lock and re-checks the scan
+        # clock, so the loser is a no-op.
+        thread = threading.Thread(target=self._scan_and_swap,
+                                  daemon=True,
+                                  name="reload-%s" % self.name)
+        self._reload_thread = thread
+        thread.start()
+
+    def _scan_and_swap(self):
+        """One version scan; on change: load + warm the fresh model,
+        then publish it.  Runs under the dedicated reload lock so
+        concurrent callers can neither duplicate the load nor swap
+        versions out of order (the execution lock stays free for
+        predicts on the old model while a new one loads)."""
         with self._reload_lock:
             now = time.monotonic()
             if now - self._last_scan < self.poll_interval:
@@ -138,10 +228,16 @@ class ModelEndpoint:
                 return
             dtypes = _leaf_dtypes(
                 fresh.manifest.get("input_signature", {}))
+            plan = (batch_plan(fresh.manifest)
+                    if self._batching is not None else None)
+            # Warm the fresh model's pad buckets BEFORE publishing it:
+            # traffic keeps hitting the warm old version while the new
+            # one compiles its bucket shapes.
+            self._warm_buckets(fresh, plan)
             with self._lock:
                 self.model = fresh
                 self._dtypes = dtypes
-                self._active = (fresh, dtypes)
+                self._active = (fresh, dtypes, plan)
                 self._loaded_dir = fresh.export_dir
         logger.info("reloaded model %r from %s (version %s)",
                     self.name, fresh.export_dir,
@@ -149,17 +245,39 @@ class ModelEndpoint:
 
     def metadata(self):
         self.maybe_reload()
+        model = self._snapshot()[0]
         return {
             "model_version_status": [{
-                "version": str(self.model.manifest.get("version", 0)),
+                "version": str(model.manifest.get("version", 0)),
                 "state": "AVAILABLE",
             }],
-            "metadata": self.model.manifest,
+            "metadata": model.manifest,
+        }
+
+    def stats(self):
+        """/statz payload: live version, batching config, Timing
+        counters (batch occupancy, queue wait, execution time)."""
+        model = self._snapshot()[0]
+        counters = self.timing.counters()
+        batches = counters.get("batcher.batches", 0)
+        return {
+            "model": self.name,
+            "version": model.manifest.get("version", 0),
+            "batching": (self._batching.describe()
+                         if self._batching is not None else None),
+            "counters": counters,
+            "timing": self.timing.summary(),
+            "mean_batch_occupancy": (
+                counters.get("batcher.rows", 0) / batches
+                if batches else None),
         }
 
     def predict(self, body):
-        self.maybe_reload()
-        model, dtypes = self._active
+        if self._batcher is None:
+            # Serialized path: reload checks stay on request threads
+            # (the batcher executor does them between batches instead).
+            self.maybe_reload()
+        model, dtypes, plan = self._snapshot()
         if "instances" in body:
             dtype = dtypes.get(None, "float32")
             inputs = np.asarray(body["instances"], dtype=dtype)
@@ -172,15 +290,24 @@ class ModelEndpoint:
             }
         else:
             raise ValueError("body needs 'instances' or 'inputs'")
-        with self._lock:
-            outputs = model.predict(inputs)
+        if self._batcher is not None:
+            outputs = self._batcher.predict(model, plan, inputs)
+        else:
+            with self._lock:
+                outputs = model.predict(inputs)
         return {"predictions": _jsonable(outputs)}
 
     def lookup(self, body):
-        self.maybe_reload()
-        vectors = self._active[0].lookup_embedding(
-            body["table"], np.asarray(body["ids"], np.int64)
-        )
+        if self._batcher is None:
+            self.maybe_reload()
+        model = self._snapshot()[0]
+        ids = np.asarray(body["ids"], np.int64)
+        if self._batcher is not None:
+            # Same admission queue as predicts: a lookup executes on
+            # ONE model snapshot, never racing a hot-swap mid-read.
+            vectors = self._batcher.lookup(model, body["table"], ids)
+        else:
+            vectors = model.lookup_embedding(body["table"], ids)
         return {"vectors": vectors.tolist()}
 
 
@@ -209,6 +336,13 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
         post_routes[base + ":lookup"] = endpoint.lookup
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 => persistent connections: without this every
+        # request pays a fresh TCP handshake (BaseHTTPRequestHandler
+        # defaults to HTTP/1.0 + Connection: close), which throttles
+        # real clients and pollutes benchmarks.  Safe here because
+        # _reply ALWAYS sets Content-Length, including error replies.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("http: " + fmt, *args)
 
@@ -225,6 +359,13 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
                 # liveness/readiness probe target (matches the
                 # master's and PS's observability surface)
                 return self._reply(200, {"status": "ok"})
+            if self.path == "/statz":
+                # Batching observability: per-model batch occupancy,
+                # queue wait, execution time, flush reasons.
+                return self._reply(200, {
+                    name: endpoint.stats()
+                    for name, endpoint in by_name.items()
+                })
             handler = get_paths.get(self.path)
             if handler is not None:
                 return self._reply(200, handler())
@@ -232,6 +373,16 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
                               % (self.path, sorted(by_name))})
 
         def do_POST(self):
+            if self.headers.get("Transfer-Encoding") or (
+                    "Content-Length" not in self.headers):
+                # Keep-alive framing depends on Content-Length: a
+                # chunked body we don't parse would desync the
+                # persistent connection (its bytes would be read as
+                # the next request line).  411 + close instead.
+                self.close_connection = True
+                return self._reply(
+                    411, {"error": "Content-Length required "
+                                   "(chunked bodies unsupported)"})
             length = int(self.headers.get("Content-Length", 0))
             try:
                 # ValueError covers JSONDecodeError AND the
@@ -258,16 +409,24 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
     return ThreadingHTTPServer((host, port), Handler)
 
 
+def batch_config_from_args(args):
+    """CLI knobs -> BatchConfig (or None when batching is off:
+    ``--enable_batching false`` or ``--max_batch_size 1`` both restore
+    the serialized per-request path exactly)."""
+    if not args.enable_batching or args.max_batch_size <= 1:
+        return None
+    buckets = [int(piece) for piece in
+               str(args.pad_buckets or "").split(",") if piece.strip()]
+    return BatchConfig(
+        max_batch_size=args.max_batch_size,
+        batch_timeout_ms=args.batch_timeout_ms,
+        pad_buckets=buckets or None,
+        warm=args.warm_buckets,
+    )
+
+
 def main(argv=None):
-    parser = argparse.ArgumentParser("elasticdl-tpu model server")
-    parser.add_argument("--export_dir", required=True,
-                        help="one export dir, or several as "
-                             "name1=dir1,name2=dir2 (the TF-Serving "
-                             "model-config role)")
-    parser.add_argument("--model_name", default=None)
-    parser.add_argument("--port", type=int, default=8501)
-    parser.add_argument("--host", default="0.0.0.0")
-    args = parser.parse_args(argv)
+    args = build_serving_parser().parse_args(argv)
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
         # The session sitecustomize can pin another backend via
         # jax.config (overriding JAX_PLATFORMS); honor the explicit
@@ -285,6 +444,7 @@ def main(argv=None):
         "=" in p and p.partition("=")[0].strip()
         and p.partition("=")[2].strip() for p in pieces
     ) and ("=" in args.export_dir)
+    batching = batch_config_from_args(args)
     if is_multi and (len(pieces) > 1 or os.path.sep not in
                      pieces[0].partition("=")[0]):
         if args.model_name:
@@ -293,18 +453,23 @@ def main(argv=None):
                 "each model explicitly", args.model_name)
         endpoints = [
             ModelEndpoint(p.partition("=")[2].strip(),
-                          name=p.partition("=")[0].strip())
+                          name=p.partition("=")[0].strip(),
+                          poll_interval=args.poll_interval,
+                          batching=batching)
             for p in pieces
         ]
     else:
         endpoints = [ModelEndpoint(args.export_dir,
-                                   name=args.model_name)]
+                                   name=args.model_name,
+                                   poll_interval=args.poll_interval,
+                                   batching=batching)]
     server = build_server(endpoints, port=args.port, host=args.host)
     logger.info(
         "serving model(s) %s on %s:%d (predict: POST "
-        "/v1/models/<name>:predict)",
+        "/v1/models/<name>:predict; batching: %s)",
         sorted(e.name for e in endpoints), args.host,
         server.server_address[1],
+        batching.describe() if batching else "off",
     )
     try:
         server.serve_forever()
@@ -312,6 +477,8 @@ def main(argv=None):
         pass
     finally:
         server.server_close()
+        for endpoint in endpoints:
+            endpoint.close()
     return 0
 
 

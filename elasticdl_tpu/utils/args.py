@@ -157,6 +157,49 @@ def build_ps_parser():
     return parser
 
 
+def add_serving_args(parser):
+    """Model-server flags (serving/server.py) — the TF-Serving
+    batching-config role, in-process."""
+    parser.add_argument("--export_dir", required=True,
+                        help="one export dir, or several as "
+                             "name1=dir1,name2=dir2 (the TF-Serving "
+                             "model-config role)")
+    parser.add_argument("--model_name", default=None)
+    parser.add_argument("--port", type=int, default=8501)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--poll_interval", type=float, default=2.0,
+                        help="seconds between version re-scans of a "
+                             "TF-Serving-style <base>/<N>/ export dir")
+    parser.add_argument("--enable_batching", type=_str2bool,
+                        default=True,
+                        help="dynamic request micro-batching "
+                             "(serving/batcher.py); false restores the "
+                             "serialized per-request execution path")
+    parser.add_argument("--max_batch_size", type=int, default=32,
+                        help="row cap per coalesced predict batch; 1 "
+                             "also disables batching entirely")
+    parser.add_argument("--batch_timeout_ms", type=float, default=2.0,
+                        help="max time the executor waits to fill a "
+                             "batch; a lone request is flushed after "
+                             "at most this long (the latency floor / "
+                             "throughput tradeoff knob)")
+    parser.add_argument("--pad_buckets", default="",
+                        help="comma-separated batch sizes requests are "
+                             "padded up to (bounds the compiled-shape "
+                             "set); default: powers of two up to "
+                             "max_batch_size")
+    parser.add_argument("--warm_buckets", type=_str2bool, default=True,
+                        help="pre-compile every pad bucket at load and "
+                             "hot-swap so no live request pays a cold "
+                             "XLA compile")
+
+
+def build_serving_parser():
+    parser = argparse.ArgumentParser("elasticdl_tpu.serving.server")
+    add_serving_args(parser)
+    return parser
+
+
 def parse_master_args(argv=None):
     return build_master_parser().parse_args(argv)
 

@@ -33,7 +33,18 @@ class Timing:
             self._events[name] += n
 
     def counters(self):
-        return dict(self._events)
+        # list() first: another thread (the serving /statz reader) may
+        # iterate this snapshot while a worker thread keeps bumping.
+        return dict(list(self._events.items()))
+
+    def observe(self, name, seconds):
+        """Record one already-measured duration — for phases whose
+        start and end happen on different threads (e.g. a serving
+        request's queue wait: enqueued on the request thread, measured
+        when the batcher executor picks it up)."""
+        if self._enabled:
+            self._totals[name] += seconds
+            self._counts[name] += 1
 
     def start(self, name):
         if self._enabled:
@@ -53,13 +64,18 @@ class Timing:
             self.end(name)
 
     def summary(self):
+        # Snapshot both dicts before deriving: a concurrent observer
+        # (serving /statz) must never hit "dict changed size during
+        # iteration" because the executor thread added a phase.
+        totals = dict(list(self._totals.items()))
+        counts = dict(list(self._counts.items()))
         return {
             name: {
-                "total_s": self._totals[name],
-                "count": self._counts[name],
-                "mean_s": self._totals[name] / max(1, self._counts[name]),
+                "total_s": totals[name],
+                "count": counts.get(name, 0),
+                "mean_s": totals[name] / max(1, counts.get(name, 0)),
             }
-            for name in self._totals
+            for name in totals
         }
 
     def report(self):
