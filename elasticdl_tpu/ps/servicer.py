@@ -132,6 +132,7 @@ class PserverServicer:
             request.gradients
         )
         lr_override = request.learning_rate or None
+        report = None
         with self._lock:
             if self._use_async:
                 lr_mult = 1.0
@@ -143,33 +144,39 @@ class PserverServicer:
                 self._apply_locked(dense, embeddings, lr_mult, lr_override)
                 self._params.version += 1
                 version = self._params.version
-                self._post_update_locked()
+                report = self._post_update_locked()
                 self.counters["push_accepted"] += 1
-                return pb.PushGradientsResponse(
+                res = pb.PushGradientsResponse(
                     accepted=True, version=version
                 )
-            # sync mode
-            if grad_version < (
+            elif grad_version < (
                 self._params.version - self._sync_version_tolerance
             ):
+                # sync mode, stale
                 self.counters["push_rejected"] += 1
-                return pb.PushGradientsResponse(
+                res = pb.PushGradientsResponse(
                     accepted=False, version=self._params.version
                 )
-            self._grad_buffer.append((dense, embeddings))
-            if len(self._grad_buffer) < self._grads_to_wait:
-                self.counters["push_accepted"] += 1
-                return pb.PushGradientsResponse(
-                    accepted=True, version=self._params.version
-                )
-            dense_sum, emb_cat = self._reduce_buffer_locked()
-            self._grad_buffer.clear()
-            self._apply_locked(dense_sum, emb_cat, 1.0, lr_override)
-            self._params.version += 1
-            version = self._params.version
-            self._post_update_locked()
-            self.counters["push_accepted"] += 1
-            return pb.PushGradientsResponse(accepted=True, version=version)
+            else:
+                self._grad_buffer.append((dense, embeddings))
+                if len(self._grad_buffer) < self._grads_to_wait:
+                    self.counters["push_accepted"] += 1
+                    res = pb.PushGradientsResponse(
+                        accepted=True, version=self._params.version
+                    )
+                else:
+                    dense_sum, emb_cat = self._reduce_buffer_locked()
+                    self._grad_buffer.clear()
+                    self._apply_locked(dense_sum, emb_cat, 1.0, lr_override)
+                    self._params.version += 1
+                    version = self._params.version
+                    report = self._post_update_locked()
+                    self.counters["push_accepted"] += 1
+                    res = pb.PushGradientsResponse(
+                        accepted=True, version=version
+                    )
+        self._report_version(report)
+        return res
 
     @rpc_error_guard
     def prepare_gradients(self, request, _context=None):
@@ -209,33 +216,37 @@ class PserverServicer:
         unconditional — staleness was settled at prepare, so the
         effective tolerance is ``sync_version_tolerance`` plus in-flight
         commit concurrency (bounded by the worker count)."""
+        report = None
         with self._lock:
             staged = self._staged.pop(request.txn_id, None)
             if not request.commit or staged is None:
-                return pb.PushGradientsResponse(
+                res = pb.PushGradientsResponse(
                     accepted=False, version=self._params.version
                 )
-            # Counted at COMMIT, the point a 2PC push becomes real —
-            # prepare-stage rejects count as push_rejected above.
-            self.counters["push_accepted"] += 1
-            dense, embeddings, lr_override, _ = staged
-            if self._use_async:
-                self._apply_locked(dense, embeddings, 1.0, lr_override)
-                self._params.version += 1
-                self._post_update_locked()
-                return pb.PushGradientsResponse(
+            else:
+                # Counted at COMMIT, the point a 2PC push becomes real —
+                # prepare-stage rejects count as push_rejected above.
+                self.counters["push_accepted"] += 1
+                dense, embeddings, lr_override, _ = staged
+                if self._use_async:
+                    self._apply_locked(dense, embeddings, 1.0, lr_override)
+                    self._params.version += 1
+                    report = self._post_update_locked()
+                else:
+                    self._grad_buffer.append((dense, embeddings))
+                    if len(self._grad_buffer) >= self._grads_to_wait:
+                        dense_sum, emb_cat = self._reduce_buffer_locked()
+                        self._grad_buffer.clear()
+                        self._apply_locked(
+                            dense_sum, emb_cat, 1.0, lr_override
+                        )
+                        self._params.version += 1
+                        report = self._post_update_locked()
+                res = pb.PushGradientsResponse(
                     accepted=True, version=self._params.version
                 )
-            self._grad_buffer.append((dense, embeddings))
-            if len(self._grad_buffer) >= self._grads_to_wait:
-                dense_sum, emb_cat = self._reduce_buffer_locked()
-                self._grad_buffer.clear()
-                self._apply_locked(dense_sum, emb_cat, 1.0, lr_override)
-                self._params.version += 1
-                self._post_update_locked()
-            return pb.PushGradientsResponse(
-                accepted=True, version=self._params.version
-            )
+        self._report_version(report)
+        return res
 
     # -- internals ----------------------------------------------------------
 
@@ -313,6 +324,12 @@ class PserverServicer:
             logger.warning("checkpoint at v%d failed: %s", v, e)
 
     def _post_update_locked(self):
+        """Checkpoint if due; returns the version to report to the
+        master (or None).  The report itself is an RPC and must happen
+        OUTSIDE self._lock — holding the update lock across the
+        master's round trip would convoy every concurrent pull/push
+        behind it (EL006) — so callers release first, then pass the
+        returned version to ``_report_version``."""
         v = self._params.version
         if (
             self._checkpoint_saver is not None
@@ -325,7 +342,14 @@ class PserverServicer:
             and self._evaluation_steps
             and v % self._evaluation_steps == 0
         ):
-            try:
-                self._master_client.report_version(v)
-            except Exception as e:  # noqa: BLE001 — master may be gone
-                logger.warning("report_version failed: %s", e)
+            return v
+        return None
+
+    def _report_version(self, v):
+        """Master-RPC half of _post_update_locked; call UNLOCKED."""
+        if v is None:
+            return
+        try:
+            self._master_client.report_version(v)
+        except Exception as e:  # noqa: BLE001 — master may be gone
+            logger.warning("report_version failed: %s", e)

@@ -530,8 +530,20 @@ class CollectiveTrainer(Trainer):
                 ) from e
 
     def flush_checkpoints(self):
-        """Join pending checkpoint writes (train end / before export)."""
-        self._surface_checkpoint_errors(wait=True)
+        """Join pending checkpoint writes AND retire the writer thread
+        (train end / before export).  Shutting the executor down here —
+        not just joining the future — is the owner's stop path (EL007):
+        a lazily re-created pool costs nothing, but a leaked one keeps
+        its thread alive past the trainer and can hang worker exit.
+        The next async save simply recreates it."""
+        try:
+            self._surface_checkpoint_errors(wait=True)
+        finally:
+            # Retire the pool even when the surfaced write error
+            # raises — the failure path must not leak the thread.
+            if self._ckpt_executor is not None:
+                self._ckpt_executor.shutdown(wait=True)
+                self._ckpt_executor = None
 
     def init_from_checkpoint(self):
         if self._checkpoint_saver is None:

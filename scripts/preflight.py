@@ -3,7 +3,10 @@
 Runs, in order, each in a fresh subprocess with the CPU platform pinned:
 
   1. elastic-lint + compileall (scripts/lint.sh — static analysis of
-     the elastic control plane, EL001-EL004)
+     the elastic control plane: per-file EL001-EL004/EL007 plus the
+     whole-program EL005 lock-order / EL006 blocking-under-lock /
+     EL008 RPC-conformance pass; emits the EL005 lock-order graph to
+     artifacts/lock_graph.dot)
   2. the full test suite (pytest tests -q)
   3. the driver's multi-chip dry run (__graft_entry__.dryrun_multichip(8))
   4. one bench.py pass (CPU; validates the JSON contract end-to-end)

@@ -1,0 +1,66 @@
+"""Seeded ABBA-deadlock fixture for elastic-lint EL005 + the runtime
+tracer's lock-order edges.
+
+Two ledgers each take their OWN lock and then call into the peer,
+which takes ITS lock — opposite orders on the two paths.  Two threads
+entering simultaneously (alpha.credit_via_beta vs
+beta.credit_via_alpha) deadlock: classic ABBA.  EL005 must flag the
+cycle statically, and ``drive_abba_sequentially`` exercises both
+orderings on ONE thread so the tracer records the A->B and B->A edges
+(and the cycle) without ever actually deadlocking the test process.
+
+This module lives in tests/ (outside the lint gate) precisely so the
+seeded bug stays seeded.
+"""
+
+import threading
+
+
+class LedgerAlpha:
+    def __init__(self, ledger_beta=None):
+        self._lock = threading.Lock()
+        self._ledger_beta = ledger_beta
+        self._balance = 0
+
+    def credit(self):
+        with self._lock:
+            self._balance += 1
+
+    def credit_via_beta(self):
+        # Holds alpha's lock while acquiring beta's: A -> B.
+        with self._lock:
+            self._balance -= 1
+            self._ledger_beta.credit()
+
+
+class LedgerBeta:
+    def __init__(self, ledger_alpha=None):
+        self._lock = threading.Lock()
+        self._ledger_alpha = ledger_alpha
+        self._balance = 0
+
+    def credit(self):
+        with self._lock:
+            self._balance += 1
+
+    def credit_via_alpha(self):
+        # Holds beta's lock while acquiring alpha's: B -> A.  Combined
+        # with credit_via_beta this closes the ABBA cycle.
+        with self._lock:
+            self._balance -= 1
+            self._ledger_alpha.credit()
+
+
+def build_pair():
+    alpha = LedgerAlpha()
+    beta = LedgerBeta(ledger_alpha=alpha)
+    alpha._ledger_beta = beta
+    return alpha, beta
+
+
+def drive_abba_sequentially(alpha, beta):
+    """Exercise BOTH acquisition orders on the calling thread — the
+    tracer observes the A->B and B->A edges (a runtime-confirmed
+    cycle) while the single thread guarantees no actual deadlock."""
+    alpha.credit_via_beta()
+    beta.credit_via_alpha()

@@ -1,0 +1,40 @@
+"""Clean-ordering counterpart to fixture_abba: two locks, one global
+acquisition order (north before south, always).  EL005 must stay
+silent on this module, and the tracer must observe edges in only one
+direction (no cycle)."""
+
+import threading
+
+
+class CourierNorth:
+    def __init__(self, courier_south=None):
+        self._lock = threading.Lock()
+        self._courier_south = courier_south
+        self._handled = 0
+
+    def handoff(self):
+        # North's lock is always the OUTER lock: N -> S only.
+        with self._lock:
+            self._handled += 1
+            self._courier_south.accept()
+
+
+class CourierSouth:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._accepted = 0
+
+    def accept(self):
+        with self._lock:
+            self._accepted += 1
+
+
+def build_pair():
+    south = CourierSouth()
+    north = CourierNorth(courier_south=south)
+    return north, south
+
+
+def drive_sequentially(north, south):
+    north.handoff()
+    south.accept()

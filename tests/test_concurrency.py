@@ -134,6 +134,12 @@ def test_task_manager_concurrent_get_report():
     # Dynamic EL001: no guarded attribute was touched off-lock during
     # the drill (would have been invisible to a pass/fail count).
     tracer.assert_clean()
+    # Dynamic EL005: no lock-order cycle among the acquisition-order
+    # edges the drill actually executed (one registered lock here, so
+    # this also pins the "no edges at all" shape — a second lock
+    # creeping into TaskManager's hot path would start recording).
+    tracer.assert_ordered()
+    assert tracer.lock_order_edges() == set()
 
 
 def test_concurrent_pulls_race_pushes_on_same_table():

@@ -300,21 +300,445 @@ def test_tracer_restores_class_on_exit():
     assert counter.value == 1
 
 
+# -- EL005 lock-order ---------------------------------------------------
+
+
+ABBA_FIXTURE = os.path.join(REPO, "tests", "fixture_abba.py")
+CLEAN_FIXTURE = os.path.join(REPO, "tests",
+                             "fixture_lock_order_clean.py")
+
+
+def _fixture_source(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_el005_flags_seeded_abba_cycle():
+    findings = check_source(_fixture_source(ABBA_FIXTURE),
+                            "tests/fixture_abba.py")
+    cycles = [f for f in findings if f.rule == "EL005"]
+    assert cycles, "seeded ABBA deadlock not detected"
+    assert cycles[0].symbol.startswith("cycle:")
+    assert "LedgerAlpha._lock" in cycles[0].symbol
+    assert "LedgerBeta._lock" in cycles[0].symbol
+
+
+def test_el005_quiet_on_global_lock_order():
+    findings = check_source(_fixture_source(CLEAN_FIXTURE),
+                            "tests/fixture_lock_order_clean.py")
+    assert "EL005" not in {f.rule for f in findings}
+
+
+EL005_SELF_DEADLOCK = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def add(self, k, v):
+            with self._lock:
+                self._items[k] = v
+                self.size()      # re-enters the non-reentrant Lock
+
+        def size(self):
+            with self._lock:
+                return len(self._items)
+"""
+
+
+def test_el005_flags_lock_reentry_self_deadlock():
+    findings = [f for f in check_source(
+        textwrap.dedent(EL005_SELF_DEADLOCK)) if f.rule == "EL005"]
+    assert findings and findings[0].symbol.startswith("self:")
+
+
+def test_el005_rlock_reentry_is_legal():
+    source = textwrap.dedent(EL005_SELF_DEADLOCK).replace(
+        "threading.Lock()", "threading.RLock()")
+    assert "EL005" not in rules_hit(source)
+
+
+# -- EL006 blocking-under-lock ------------------------------------------
+
+
+EL006_BAD = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = 0
+
+        def poll(self):
+            with self._lock:
+                self._state += 1
+                self._settle()
+
+        def _settle(self):
+            time.sleep(0.1)
+"""
+
+EL006_GOOD = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = 0
+
+        def poll(self):
+            with self._lock:
+                self._state += 1
+            self._settle()
+
+        def _settle(self):
+            time.sleep(0.1)
+"""
+
+
+def test_el006_flags_transitive_blocking_under_lock():
+    findings = [f for f in check_source(textwrap.dedent(EL006_BAD))
+                if f.rule == "EL006"]
+    # flagged BOTH at the locked call site (the fix site) and nowhere
+    # else — _settle itself holds no lock.
+    assert findings
+    assert all("_settle" in f.symbol or "sleep" in f.symbol
+               for f in findings)
+    assert any("time.sleep" in f.message for f in findings)
+
+
+def test_el006_quiet_when_blocking_moves_outside():
+    assert "EL006" not in rules_hit(EL006_GOOD)
+
+
+def test_el006_direct_rpc_under_lock():
+    source = """
+        import threading
+        from elasticdl_tpu.proto.rpc import MasterStub
+
+        class Reporter:
+            def __init__(self, channel):
+                self._lock = threading.Lock()
+                self._stub = MasterStub(channel)
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+                    self._stub.report_version(None)
+    """
+    findings = [f for f in check_source(textwrap.dedent(source))
+                if f.rule == "EL006"]
+    assert findings and "RPC" in findings[0].message
+
+
+# -- EL007 executor lifecycle -------------------------------------------
+
+
+EL007_BAD = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Pusher:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(max_workers=1)
+"""
+
+EL007_GOOD = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Pusher:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(max_workers=1)
+
+        def close(self):
+            self._pool.shutdown(wait=True)
+
+    def one_shot(fn):
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            return pool.submit(fn).result()
+
+    def build_server(grpc):
+        # ownership handoff: grpc.server owns the pool's lifecycle
+        return grpc.server(ThreadPoolExecutor(max_workers=4))
+"""
+
+
+def test_el007_flags_shutdownless_executor():
+    findings = [f for f in check_source(textwrap.dedent(EL007_BAD))
+                if f.rule == "EL007"]
+    assert findings
+    assert findings[0].symbol == "ThreadPoolExecutor:self._pool"
+
+
+def test_el007_quiet_on_shutdown_with_and_handoff():
+    assert "EL007" not in rules_hit(EL007_GOOD)
+
+
+# -- EL008 RPC conformance ----------------------------------------------
+
+
+EL008_CLIENT = """
+    from elasticdl_tpu.proto import elastic_pb2 as pb
+    from elasticdl_tpu.proto.rpc import MasterStub
+
+    class Client:
+        def __init__(self, channel):
+            self._stub = MasterStub(channel)
+
+        def good(self):
+            req = pb.GetTaskRequest(worker_id=3)
+            return self._stub.get_task(req)
+
+        def unknown_method(self):
+            return self._stub.fetch_task(None)
+
+        def wrong_request(self):
+            req = pb.ReportVersionRequest(model_version=1)
+            return self._stub.get_task(req)
+
+        def unknown_ctor_field(self):
+            return pb.GetTaskRequest(worker_rank=3)
+
+        def unknown_attr_field(self):
+            req = pb.GetTaskRequest(worker_id=3)
+            req.task_kind = 1
+            return req
+
+        def bogus_enum(self):
+            return pb.TRAINING_V2
+"""
+
+
+def test_el008_flags_stub_and_field_drift():
+    findings = [f for f in check_source(textwrap.dedent(EL008_CLIENT))
+                if f.rule == "EL008"]
+    messages = " ".join(f.message for f in findings)
+    assert "fetch_task() is not a method" in messages
+    assert "registers request type GetTaskRequest" in messages
+    assert "unknown field 'worker_rank'" in messages
+    assert "unknown field GetTaskRequest.task_kind" in messages
+    assert "pb.TRAINING_V2 is neither" in messages
+    # the valid call path produced no finding
+    assert not any(".good" in f.symbol for f in findings)
+
+
+def test_el008_proto_parser_reads_real_schema():
+    from tools.elastic_lint.el008_rpc_conformance import (
+        load_proto_fields,
+    )
+
+    fields, enums = load_proto_fields(REPO)
+    assert "worker_id" in fields["GetTaskRequest"]
+    assert "wire_dtype" in fields["TensorPB"]
+    assert "exec_counters" in fields["ReportTaskResultRequest"]  # map
+    assert "TRAINING" in enums and "LOOP_START" in enums
+
+
+def test_el008_flags_uncalled_service_method():
+    source = textwrap.dedent(EL008_CLIENT) + textwrap.dedent("""
+        SERVICES = {
+            "elasticdl_tpu.Master": {
+                "get_task": (pb.GetTaskRequest, pb.GetTaskResponse),
+                "dead_rpc": (pb.Empty, pb.Empty),
+            },
+        }
+
+        class MasterServicer:
+            def get_task(self, request, _context=None):
+                return request
+
+            def dead_rpc(self, request, _context=None):
+                return request
+    """)
+    findings = [f for f in check_source(source)
+                if f.rule == "EL008"]
+    assert any("dead_rpc has no client stub caller" in f.message
+               for f in findings)
+    assert not any("get_task has no client" in f.message
+                   for f in findings)
+
+
+# -- tracer lock-order edges --------------------------------------------
+
+
+def test_tracer_confirms_seeded_abba_at_runtime():
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import fixture_abba
+
+    alpha, beta = fixture_abba.build_pair()
+    tracer = LockDisciplineTracer()
+    alpha._lock = tracer.register_lock(alpha._lock, "LedgerAlpha._lock")
+    beta._lock = tracer.register_lock(beta._lock, "LedgerBeta._lock")
+    fixture_abba.drive_abba_sequentially(alpha, beta)
+    assert tracer.lock_order_edges() == {
+        ("LedgerAlpha._lock", "LedgerBeta._lock"),
+        ("LedgerBeta._lock", "LedgerAlpha._lock"),
+    }
+    cycles = tracer.order_violations()
+    assert cycles, "runtime ABBA cycle not detected"
+    try:
+        tracer.assert_ordered()
+    except AssertionError as e:
+        assert "LedgerAlpha._lock" in str(e)
+    else:
+        raise AssertionError("assert_ordered did not raise")
+
+
+def test_tracer_order_edges_confirm_static_cycle():
+    """The merge path: static EL005 graph + observed runtime edges —
+    the seeded cycle is CONFIRMED (every edge actually executed)."""
+    import ast as ast_mod
+
+    from tools.elastic_lint import lock_graph as lg
+    from tools.elastic_lint import program as pm
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import fixture_abba
+
+    source = _fixture_source(ABBA_FIXTURE)
+    summary = pm.summarize_module(
+        ast_mod.parse(source), source, "tests/fixture_abba.py")
+    prog = pm.Program([summary])
+    graph = lg.build_graph(prog)
+    assert graph.cycles() and not graph.confirmed_cycles()
+
+    alpha, beta = fixture_abba.build_pair()
+    tracer = LockDisciplineTracer()
+    prefix = "tests.fixture_abba."
+    alpha._lock = tracer.register_lock(
+        alpha._lock, prefix + "LedgerAlpha._lock")
+    beta._lock = tracer.register_lock(
+        beta._lock, prefix + "LedgerBeta._lock")
+    fixture_abba.drive_abba_sequentially(alpha, beta)
+    graph.merge_observed(tracer.lock_order_edges())
+    assert graph.confirmed_cycles() == graph.cycles()
+
+
+def test_tracer_quiet_on_clean_ordering():
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import fixture_lock_order_clean as clean
+
+    north, south = clean.build_pair()
+    tracer = LockDisciplineTracer()
+    north._lock = tracer.register_lock(north._lock, "North._lock")
+    south._lock = tracer.register_lock(south._lock, "South._lock")
+    clean.drive_sequentially(north, south)
+    assert tracer.lock_order_edges() == {("North._lock", "South._lock")}
+    tracer.assert_ordered()  # one-directional: no cycle
+
+
+# -- baseline hygiene ----------------------------------------------------
+
+
+def test_missing_explicit_baseline_is_hard_error(tmp_path):
+    from tools.elastic_lint.suppressions import load_baseline
+
+    try:
+        load_baseline(str(tmp_path / "nope.txt"))
+    except FileNotFoundError as e:
+        assert "does not exist" in str(e)
+    else:
+        raise AssertionError("missing baseline did not raise")
+    assert load_baseline(None) == set()
+
+
+def test_stale_baseline_entry_fails_the_run(tmp_path):
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "EL001 elasticdl_tpu/no/such/file.py Gone.method.attr "
+        "-- obsolete\n")
+    findings = run_paths(
+        [os.path.join(REPO, "tools", "elastic_lint")],
+        baseline_path=str(baseline),
+    )
+    stale = [f for f in findings if f.rule == "ELSTALE"]
+    assert stale, "zombie baseline entry not reported"
+    assert "Gone.method.attr" in stale[0].symbol
+
+
+def test_baseline_entries_outside_scan_scope_are_left_alone(tmp_path):
+    """A partial-tree run must not flag the rest of the baseline."""
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "EL001 elasticdl_tpu/ps/servicer.py "
+        "PserverServicer.pull_embedding_vectors.counters -- real\n")
+    findings = run_paths(
+        [os.path.join(REPO, "tools", "elastic_lint")],
+        baseline_path=str(baseline),
+    )
+    assert not [f for f in findings if f.rule == "ELSTALE"]
+
+
+# -- artifacts & parallelism --------------------------------------------
+
+
+def test_lock_graph_artifact_produced_and_acyclic():
+    """CI artifact contract: the lint gate emits the EL005 lock-order
+    graph; its non-baselined subgraph must be acyclic (a baselined
+    cycle would carry ``baselined: true`` and a justification in
+    baseline.txt)."""
+    import json
+
+    artifact = os.path.join(REPO, "artifacts", "lock_graph.json")
+    findings = run_paths(
+        [os.path.join(REPO, "elasticdl_tpu"),
+         os.path.join(REPO, "tools")],
+        baseline_path=DEFAULT_BASELINE,
+        graph_out=artifact,
+    )
+    assert not [f for f in findings if f.rule == "EL005"]
+    assert os.path.isfile(artifact)
+    with open(artifact, encoding="utf-8") as f:
+        data = json.load(f)
+    assert data["nodes"], "graph artifact lost the repo's lock nodes"
+    unbaselined = [c for c in data["cycles"] if not c["baselined"]]
+    assert not unbaselined, (
+        "non-baselined lock-order cycles: %s" % unbaselined)
+    # the known cross-component edges are present (docs embed these)
+    edges = {(e["src"], e["dst"]) for e in data["edges"]}
+    assert (
+        "elasticdl_tpu.master.evaluation_service.EvaluationService._lock",
+        "elasticdl_tpu.master.task_manager.TaskManager._lock",
+    ) in edges
+    assert (
+        "elasticdl_tpu.ps.servicer.PserverServicer._lock",
+        "elasticdl_tpu.ps.parameters.Parameters._lock",
+    ) in edges
+
+
+def test_parallel_jobs_match_serial_findings():
+    from tools.elastic_lint import build_program
+
+    target = [os.path.join(REPO, "elasticdl_tpu", "master")]
+    serial, _ = build_program(target, jobs=1)
+    parallel, _ = build_program(target, jobs=2)
+    assert sorted(serial) == sorted(parallel)
+
+
 # -- the repo gate ------------------------------------------------------
 
 
 def test_repo_is_lint_clean():
-    """Tier-1 enforcement: the package must stay clean under
-    EL001-EL004 (modulo the justified baseline).  A regression here
-    means a new unsynchronized access, unguarded servicer RPC, impure
-    traced function, or shutdown-less thread entered the codebase."""
+    """Tier-1 enforcement: the repo must stay clean under the per-file
+    rules (EL001-EL004/EL007) AND the whole-program rules (EL005
+    lock-order, EL006 blocking-under-lock, EL008 RPC conformance),
+    modulo the justified baseline — and every baseline entry must
+    still match a live finding (ELSTALE).  Targets mirror
+    scripts/lint.sh's auto-discovery: a new bench_*.py or script
+    cannot dodge the gate."""
+    import glob
+
     findings = run_paths(
         [os.path.join(REPO, "elasticdl_tpu"),
          os.path.join(REPO, "tools"),
-         # The PS overlap bench spawns servers and drives the pipelined
-         # trainer's thread machinery — hold it to the same bar.
-         os.path.join(REPO, "bench_ps_wire.py")],
+         os.path.join(REPO, "scripts")]
+        + sorted(glob.glob(os.path.join(REPO, "bench_*.py"))),
         baseline_path=DEFAULT_BASELINE,
+        jobs=2,
     )
     assert not findings, "\n".join(
         "%s:%d: %s %s" % (f.path, f.line, f.rule, f.message)

@@ -9,7 +9,7 @@ no shutdown path.  Round-5 advisories found exactly these classes of
 bug (epoch/rank races in parallel/distributed.py and api/controller.py)
 — this package mechanically enforces them.
 
-Rules (each in its own module, registered in ``RULES``):
+Per-file rules (each in its own module, registered in ``RULES``):
 
   EL001 lock-discipline   an attribute mutated under ``with self._lock``
                           in one method must never be read or mutated
@@ -22,6 +22,21 @@ Rules (each in its own module, registered in ``RULES``):
                           jit/pmap/shard_map-traced functions
   EL004 thread-hygiene    every ``threading.Thread``/``Timer`` must be
                           daemonized or joined
+  EL007 lifecycle         every ``ThreadPoolExecutor`` must be shut
+                          down on its owner's stop path (or handed off)
+
+Whole-program rules (``PROGRAM_RULES``, run over the stitched
+``program.Program`` model of every scanned file):
+
+  EL005 lock-order        interprocedural lock-acquisition graph;
+                          cycles = potential ABBA deadlocks; emit the
+                          graph with ``--graph-out file.{dot,json}``
+  EL006 blocking-under-lock  RPCs, future.result, queue.get/join,
+                          model.predict, time.sleep, subprocess waits
+                          reached while a lock is held (registry in
+                          ``blocking.py``)
+  EL008 rpc-conformance   client stub calls vs the hand-registered
+                          service tables vs elastic.proto fields
 
 Suppressions (both forms REQUIRE a justification after ``--``):
 
@@ -29,12 +44,15 @@ Suppressions (both forms REQUIRE a justification after ``--``):
            the immediately preceding line
   baseline ``tools/elastic_lint/baseline.txt`` lines of the form
            ``RULE path symbol -- reason`` (symbol as reported, e.g.
-           ``PserverServicer.pull_embedding_vectors.counters``)
+           ``PserverServicer.pull_embedding_vectors.counters``).
+           A baseline entry that no longer matches any raw finding is
+           itself an error (``ELSTALE``) — zombie suppressions die.
 
-Adding a rule: create ``el0xx_name.py`` exposing ``RULE_ID`` and
-``check(tree, source, path) -> [Finding]``, then append it to ``RULES``.
-The runtime half (a ThreadSanitizer-lite for the same lock-discipline
-invariant) lives in ``runtime_tracer``.
+Adding a per-file rule: create ``el0xx_name.py`` exposing ``RULE_ID``
+and ``check(tree, source, path) -> [Finding]``, append it to ``RULES``.
+A whole-program rule exposes ``check_program(program) -> [Finding]``
+and joins ``PROGRAM_RULES``.  The runtime half (lock discipline AND
+lock-order edge recording) lives in ``runtime_tracer``.
 """
 
 import ast
@@ -50,7 +68,15 @@ from tools.elastic_lint import (  # noqa: E402  (Finding must exist first)
     el002_servicer_safety,
     el003_jit_purity,
     el004_thread_hygiene,
+    el007_lifecycle,
     suppressions,
+)
+from tools.elastic_lint import (  # noqa: E402
+    el005_lock_order,
+    el006_blocking_under_lock,
+    el008_rpc_conformance,
+    lock_graph,
+    program as program_model,
 )
 
 RULES = (
@@ -58,6 +84,13 @@ RULES = (
     el002_servicer_safety,
     el003_jit_purity,
     el004_thread_hygiene,
+    el007_lifecycle,
+)
+
+PROGRAM_RULES = (
+    el005_lock_order,
+    el006_blocking_under_lock,
+    el008_rpc_conformance,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
@@ -66,10 +99,12 @@ DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
 
 
-def check_source(source, path="<string>", rules=RULES):
-    """Run ``rules`` over one file's source; returns raw findings
-    (inline pragmas applied, baseline NOT applied) — the unit-test
-    entry point for known-good/known-bad fixtures."""
+def check_source(source, path="<string>", rules=RULES,
+                 program_rules=PROGRAM_RULES):
+    """Run per-file AND whole-program rules over one file's source
+    (the single-module program); returns raw findings (inline pragmas
+    applied, baseline NOT applied) — the unit-test entry point for
+    known-good/known-bad fixtures."""
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -78,6 +113,11 @@ def check_source(source, path="<string>", rules=RULES):
     findings = []
     for rule in rules:
         findings.extend(rule.check(tree, source, path))
+    if program_rules:
+        summary = program_model.summarize_module(tree, source, path)
+        prog = program_model.Program([summary], repo_root=REPO_ROOT)
+        for rule in program_rules:
+            findings.extend(rule.check_program(prog))
     return suppressions.apply_inline(findings, source)
 
 
@@ -95,14 +135,75 @@ def iter_python_files(paths):
                     yield os.path.join(dirpath, name)
 
 
-def run_paths(paths, baseline_path=DEFAULT_BASELINE, rules=RULES):
-    """Lint every .py under ``paths``; returns findings that survive
-    both inline pragmas and the baseline file."""
-    baseline = suppressions.load_baseline(baseline_path)
+def _analyze_file(path):
+    """Parse + per-file rules + module summary for ONE file (the unit
+    ``--jobs N`` farms to worker processes; everything returned is
+    pickleable)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        bad = Finding("E999", rel, e.lineno or 0, "<parse>",
+                      "syntax error: %s" % e.msg)
+        return [bad], program_model.ModuleSummary(rel, rel)
     findings = []
-    for path in iter_python_files(paths):
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-        rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
-        findings.extend(check_source(source, rel, rules=rules))
-    return suppressions.apply_baseline(findings, baseline)
+    for rule in RULES:
+        findings.extend(rule.check(tree, source, rel))
+    findings = suppressions.apply_inline(findings, source)
+    summary = program_model.summarize_module(tree, source, rel)
+    return findings, summary
+
+
+def build_program(paths, jobs=1):
+    """Parse every .py under ``paths`` into (per-file findings,
+    Program).  ``jobs > 1`` analyzes files in a process pool; module
+    summaries are plain data, so only the stitch runs serially."""
+    files = list(iter_python_files(paths))
+    if jobs and jobs > 1 and len(files) > 1:
+        from concurrent.futures import ProcessPoolExecutor
+        with ProcessPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(_analyze_file, files))
+    else:
+        results = [_analyze_file(path) for path in files]
+    findings = []
+    summaries = []
+    for file_findings, summary in results:
+        findings.extend(file_findings)
+        summaries.append(summary)
+    return findings, program_model.Program(summaries,
+                                           repo_root=REPO_ROOT)
+
+
+def run_paths(paths, baseline_path=DEFAULT_BASELINE, jobs=1,
+              graph_out=None):
+    """Lint every .py under ``paths`` (per-file + whole-program rules);
+    returns findings that survive both inline pragmas and the baseline
+    file, plus ``ELSTALE`` findings for baseline entries that no longer
+    match anything.  ``graph_out`` writes the EL005 lock-order graph
+    artifact (DOT, or JSON when the path ends in .json)."""
+    baseline = suppressions.load_baseline(baseline_path)
+    raw, prog = build_program(paths, jobs=jobs)
+    program_findings = []
+    for rule in PROGRAM_RULES:
+        program_findings.extend(rule.check_program(prog))
+    raw.extend(suppressions.apply_inline_map(
+        program_findings, prog.pragmas_by_path))
+
+    if graph_out is not None:
+        graph = lock_graph.build_graph(prog)
+        baselined = {sym for (r, _, sym) in baseline if r == "EL005"}
+        out_dir = os.path.dirname(os.path.abspath(graph_out))
+        if out_dir and not os.path.isdir(out_dir):
+            os.makedirs(out_dir, exist_ok=True)
+        graph.write(graph_out, baselined_signatures=baselined)
+
+    surviving = suppressions.apply_baseline(raw, baseline)
+    surviving.extend(
+        suppressions.stale_baseline_findings(
+            baseline, raw,
+            scanned_paths={s.path for s in prog.modules.values()},
+            repo_root=REPO_ROOT,
+        ))
+    return surviving

@@ -1,10 +1,14 @@
 """CLI: ``python -m tools.elastic_lint [paths...]``.
 
 Exits 1 when findings survive inline pragmas and the baseline file,
-0 on a clean run.  ``--no-baseline`` reports everything (audit mode).
+0 on a clean run.  ``--no-baseline`` reports everything (audit mode);
+``--jobs N`` analyzes files in N worker processes (0 = one per CPU);
+``--graph-out FILE`` writes the EL005 lock-order graph artifact (DOT,
+or JSON when FILE ends in .json).
 """
 
 import argparse
+import os
 import sys
 
 from tools.elastic_lint import DEFAULT_BASELINE, REPO_ROOT, run_paths
@@ -13,17 +17,24 @@ from tools.elastic_lint import DEFAULT_BASELINE, REPO_ROOT, run_paths
 def main(argv=None):
     parser = argparse.ArgumentParser(
         "elastic-lint",
-        description="project-native static analysis (EL001-EL004)")
+        description="project-native static analysis (EL001-EL008)")
     parser.add_argument("paths", nargs="*",
                         default=["elasticdl_tpu"],
                         help="files or directories to lint")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE)
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore the baseline file (full audit)")
+    parser.add_argument("--jobs", type=int, default=1,
+                        help="parallel file analysis (0 = cpu count)")
+    parser.add_argument("--graph-out", default=None, metavar="FILE",
+                        help="write the EL005 lock-order graph "
+                             "(.dot or .json)")
     args = parser.parse_args(argv)
 
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     baseline = None if args.no_baseline else args.baseline
-    findings = run_paths(args.paths, baseline_path=baseline)
+    findings = run_paths(args.paths, baseline_path=baseline,
+                         jobs=jobs, graph_out=args.graph_out)
     for f in sorted(findings, key=lambda f: (f.path, f.line)):
         print("%s:%d: %s [%s] %s"
               % (f.path, f.line, f.rule, f.symbol, f.message))

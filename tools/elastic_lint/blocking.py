@@ -1,0 +1,160 @@
+"""Known-blocking call registry — shared by EL006 and the docs.
+
+One place answers "does this call park the calling thread?" so every
+rule (and every reviewer) judges convoys against the same list.  The
+registry has three tiers, from most to least certain:
+
+  1. fully-qualified calls (``time.sleep``, ``subprocess.run``) —
+     always blocking, no receiver knowledge needed;
+  2. method names that block on ANY receiver (``.result()`` on a
+     future, ``.communicate()``, ``.serve_forever()``);
+  3. method names that block only on the right KIND of receiver
+     (``.get``/``.put`` on a queue, ``.join`` on a thread/process/
+     queue, ``.wait`` on an event-but-not-a-condition) — these consult
+     the caller-supplied type hints and a naming heuristic, because
+     ``dict.get`` and ``str.join`` must not fire.
+
+RPC stub invocations (``stub.get_task(req)``) are the fourth class:
+they are recognized structurally by the program model (a receiver
+whose inferred constructor ends in ``Stub``), not by name here —
+see ``classify_call``.  ``stub.method.future(req)`` is NOT blocking
+(the block moves to the ``.result()`` call, which tier 2 catches).
+
+``classify_call(call, type_of)`` returns a short human description of
+why the call blocks, or None.  ``type_of(node) -> ("ctor", name) |
+None`` is the caller's local/attribute type oracle.
+"""
+
+import ast
+
+# -- tier 1: fully-qualified calls ----------------------------------------
+
+QUALIFIED_BLOCKING = {
+    ("time", "sleep"): "time.sleep()",
+    ("subprocess", "run"): "subprocess.run()",
+    ("subprocess", "call"): "subprocess.call()",
+    ("subprocess", "check_call"): "subprocess.check_call()",
+    ("subprocess", "check_output"): "subprocess.check_output()",
+    ("grpc_utils", "wait_for_channel_ready"):
+        "grpc_utils.wait_for_channel_ready()",
+}
+
+# -- tier 2: methods that block on any receiver ---------------------------
+
+METHOD_BLOCKING_ANY = {
+    "communicate": "subprocess communicate()",
+    "serve_forever": "serve_forever()",
+    "wait_for_termination": "server.wait_for_termination()",
+    "predict": "model.predict() (XLA execution)",
+}
+
+# -- tier 3: methods that block on the right kind of receiver -------------
+
+# ctor names whose instances have blocking get/put/join semantics
+QUEUE_TYPES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+               "JoinableQueue"}
+JOINABLE_TYPES = QUEUE_TYPES | {
+    "Thread", "Timer", "Process", "Popen",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+}
+WAITABLE_TYPES = {"Event", "Barrier", "Popen", "Process", "Thread"}
+# Condition.wait RELEASES the lock while waiting — holding the
+# condition's own lock across .wait() is the intended protocol, so a
+# condition-typed (or condition-named) receiver never fires.
+CONDITION_HINTS = ("cond", "condition")
+
+_QUEUE_NAME_HINTS = ("queue", "_q")
+# `.result()` is only a future's blocking wait when the receiver looks
+# like one — the repo's streaming Metric.result() must not fire.  A
+# chained `pool.submit(...).result()` / `stub.m.future(req).result()`
+# is recognized structurally.
+_FUTURE_NAME_HINTS = ("future", "fut")
+_FUTURE_SHORT_NAMES = ("f",)
+_FUTURE_PRODUCERS = ("submit", "future")
+_JOIN_NAME_HINTS = ("thread", "worker", "watcher", "proc", "pool",
+                    "queue", "timer", "fetcher", "reaper")
+_WAIT_NAME_HINTS = ("event", "stopped", "done", "ready", "closed",
+                    "exhausted", "proc", "barrier")
+
+
+def _receiver_name(node):
+    """Best-effort display/heuristic name for a call receiver."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _receiver_name(node.value)
+    return None
+
+
+def _hinted(name, hints):
+    return name is not None and any(h in name.lower() for h in hints)
+
+
+def classify_call(call, type_of=None):
+    """Return a blocking description for ``call`` or None.
+
+    ``type_of(receiver_node)`` may return ``("ctor", Name)`` /
+    ``("ctorlist", Name)`` when the receiver's constructor is known
+    (from ``self._x = Queue()``-style inference), ``("stub", Name)``
+    for RPC stubs, or None.
+    """
+    func = call.func
+    # tier 1 — module.attr calls
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)):
+        desc = QUALIFIED_BLOCKING.get((func.value.id, func.attr))
+        if desc is not None:
+            return desc
+    if isinstance(func, ast.Name) and func.id == "sleep":
+        return "sleep()"
+
+    if not isinstance(func, ast.Attribute):
+        return None
+    method = func.attr
+    receiver = func.value
+    name = _receiver_name(receiver)
+    ctor = None
+    if type_of is not None:
+        t = type_of(receiver)
+        if t and t[0] in ("ctor", "ctorlist", "stub"):
+            ctor = t[1]
+            if t[0] == "stub":
+                return "RPC %s() on %s" % (method, ctor)
+    if ctor is not None and ctor.endswith("Stub"):
+        return "RPC %s() on %s" % (method, ctor)
+
+    # tier 2
+    if method in METHOD_BLOCKING_ANY:
+        return METHOD_BLOCKING_ANY[method]
+
+    # tier 3 — receiver-kind gated
+    if method == "result":
+        if (_hinted(name, _FUTURE_NAME_HINTS)
+                or name in _FUTURE_SHORT_NAMES
+                or (isinstance(receiver, ast.Call)
+                    and isinstance(receiver.func, ast.Attribute)
+                    and receiver.func.attr in _FUTURE_PRODUCERS)):
+            return "future.result()"
+        return None
+    if method in ("get", "put"):
+        if ctor in QUEUE_TYPES or (
+                ctor is None and _hinted(name, _QUEUE_NAME_HINTS)):
+            return "queue.%s()" % method
+        return None
+    if method == "join":
+        if isinstance(receiver, ast.Constant):
+            return None  # "".join(...)
+        if ctor in JOINABLE_TYPES or (
+                ctor is None and _hinted(name, _JOIN_NAME_HINTS)):
+            return "%s.join()" % (name or "thread")
+        return None
+    if method == "wait":
+        if ctor == "Condition" or _hinted(name, CONDITION_HINTS):
+            return None  # releases the lock while waiting
+        if ctor in WAITABLE_TYPES or (
+                ctor is None and _hinted(name, _WAIT_NAME_HINTS)):
+            return "%s.wait()" % (name or "event")
+        return None
+    return None

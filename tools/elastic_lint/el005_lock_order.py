@@ -1,0 +1,69 @@
+"""EL005 — whole-program lock-order deadlock detection.
+
+Builds the interprocedural lock-acquisition graph (lock_graph.py) over
+the Program model: an edge A -> B whenever B can be acquired while A
+is held — lexically nested ``with`` blocks, or A held across a
+project-local call whose transitive callees acquire B.  Findings:
+
+  - a cycle among distinct locks (potential ABBA deadlock: two threads
+    entering the cycle from different points wedge each other), one
+    finding per strongly-connected component, symbol
+    ``cycle:lockA->lockB->lockA`` (stable for baselining);
+  - a self-edge on a non-reentrant ``Lock`` (acquiring a plain Lock
+    while holding it deadlocks the thread on ITSELF — only ``RLock``
+    may nest).
+
+The elastic control plane makes this class of bug fire in production:
+worker churn drives the master's callbacks (exit, timeout, rendezvous)
+concurrently with servicer RPCs, so any two components that take each
+other's locks in opposite orders WILL eventually interleave.
+
+The static graph is the same shape the runtime tracer emits
+(``lock_order_edges``), so ``test_concurrency`` can confirm or refute
+static cycles against observed orderings.  Emit the graph artifact
+with ``--graph-out`` (DOT or JSON by extension).
+"""
+
+from tools.elastic_lint import Finding
+from tools.elastic_lint import lock_graph as lg
+
+RULE_ID = "EL005"
+
+
+def _lock_file(prog, display):
+    """Best-effort source path for a lock's defining module."""
+    for modname, modsum in prog.modules.items():
+        if display.startswith(modname + "."):
+            return modsum.path
+    return "<program>"
+
+
+def check_program(prog):
+    graph = lg.build_graph(prog)
+    findings = []
+    for cycle in graph.cycles():
+        signature = graph.cycle_signature(cycle)
+        first = cycle[0]
+        witnesses = []
+        for pair in zip(cycle, cycle[1:]):
+            sites = graph.edges.get(pair, [])
+            witnesses.append("%s->%s via %s" % (
+                pair[0], pair[1], sites[0] if sites else "?"))
+        findings.append(Finding(
+            RULE_ID, _lock_file(prog, first), 0, signature,
+            "lock-order cycle (potential ABBA deadlock): %s — two "
+            "threads entering this cycle from different locks can "
+            "each block on the other forever; acquire these locks in "
+            "one global order [%s]"
+            % (" -> ".join(cycle), "; ".join(witnesses)),
+        ))
+    for name in graph.self_deadlocks():
+        sites = graph.edges.get((name, name), [])
+        findings.append(Finding(
+            RULE_ID, _lock_file(prog, name), 0, "self:" + name,
+            "non-reentrant Lock %s can be re-acquired while already "
+            "held (%s): the thread deadlocks on itself — use RLock or "
+            "restructure so the inner path is *_locked (caller holds)"
+            % (name, sites[0] if sites else "?"),
+        ))
+    return findings

@@ -1,0 +1,82 @@
+"""EL006 — blocking call reached while a lock is held (the convoy
+class).
+
+A lock region should bound a few microseconds of pointer surgery; an
+RPC, a ``future.result()``, a ``queue.get``/``join``, a
+``model.predict`` or a ``time.sleep`` inside one turns every other
+thread needing that lock into a convoy behind the network/XLA — PRs
+2-3 each burned review effort hand-hunting exactly this (a background
+push sharing the pull channel, predict under the global lock).
+
+The rule is interprocedural: a blocking op counts when it is reached
+with a lock held EITHER directly (``with self._lock: time.sleep(...)``)
+or through any chain of project-local calls (``with self._lock:
+self._client.flush()`` where ``flush`` eventually calls
+``future.result()``).  The shared known-blocking registry lives in
+``tools/elastic_lint/blocking.py`` — rules and reviewers judge against
+the same list.
+
+Findings anchor at the point where the lock is held (the fix site):
+symbol ``Qualname.op`` for direct ops, ``Qualname.callee`` for calls
+whose transitive callees block; messages carry the full witness chain
+down to the blocking call.
+"""
+
+from tools.elastic_lint import Finding
+from tools.elastic_lint.program import lock_display
+
+RULE_ID = "EL006"
+
+
+def _held_display(prog, fid, held):
+    return "/".join(sorted(
+        lock_display(prog.resolve_lock(fid, h)) for h in held))
+
+
+def check_program(prog):
+    findings = []
+    may_block = prog.may_block()
+    seen = set()
+
+    def emit(fid, modsum, fsum, line, op_key, held, detail):
+        key = (fid, op_key, _held_display(prog, fid, held))
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(Finding(
+            RULE_ID, modsum.path, line,
+            "%s.%s" % (fsum.qualname, op_key),
+            "blocking call while holding %s: %s — every thread "
+            "contending for the lock convoys behind it; move the "
+            "blocking work outside the region (snapshot under the "
+            "lock, block outside) or justify it"
+            % (_held_display(prog, fid, held), detail),
+        ))
+
+    for fid, (modsum, _, fsum) in prog.functions.items():
+        for desc, line, held in fsum.blocking:
+            if not held:
+                continue
+            op_key = desc.split("(")[0].split()[-1].split(".")[-1]
+            emit(fid, modsum, fsum, line, op_key, held, desc)
+
+    calls = prog._resolve_all_calls()
+    for fid, out in calls.items():
+        modsum, _, fsum = prog.functions[fid]
+        for callee, line, held, callref in out:
+            if not held:
+                continue
+            blocked = may_block.get(callee, {})
+            if not blocked:
+                continue
+            descs = sorted(blocked)
+            chains = [prog.chain(callee, d, may_block)
+                      for d in descs[:2]]
+            callee_name = callref[-1]
+            emit(
+                fid, modsum, fsum, line, callee_name, held,
+                "%s() transitively blocks on %s [%s]"
+                % (callee_name, ", ".join(descs[:3]),
+                   "; ".join(chains)),
+            )
+    return findings

@@ -1,0 +1,98 @@
+"""EL007 — thread/executor lifecycle: every pool gets a shutdown
+story, every owner a stop path.
+
+EL004 polices bare ``Thread``/``Timer`` construction; this rule
+extends the same discipline to the executors the codebase grew in
+PRs 2-3 (``ThreadPoolExecutor``/``ProcessPoolExecutor``) and closes
+EL004's class-shaped gap.  An executor whose owner never calls
+``shutdown()`` leaks its worker threads past the owner's stop path —
+on the elastic control plane that is a worker process that cannot
+exit after ``close()`` (hanging the relaunch budget) or a trainer
+whose push pool keeps gRPC channels alive into interpreter teardown.
+
+Every executor construction must satisfy one of:
+
+  - used as a context manager (``with ThreadPoolExecutor(...) as x:``);
+  - passed DIRECTLY as an argument to another call (ownership handoff
+    — e.g. ``grpc.server(ThreadPoolExecutor(...))``: the receiver owns
+    the lifecycle);
+  - bound to a variable/attribute on which ``.shutdown(...)`` is
+    called somewhere in the module (the owner's stop path).
+
+Like EL004 the check is module-local and name-based; an executor whose
+shutdown lives in another module gets a suppression naming the owner.
+"""
+
+import ast
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL007"
+EXECUTOR_TYPES = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+
+def _target_key(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)):
+        return "%s.%s" % (node.value.id, node.attr)
+    return None
+
+
+def _ctor_leaf(call):
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def check(tree, source, path):
+    findings = []
+    shutdown_keys = set()
+    handed_off = set()    # id() of ctor Calls whose lifecycle moved
+    bound_keys = {}       # id(ctor Call) -> [target keys]
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "shutdown"):
+                key = _target_key(node.func.value)
+                if key:
+                    shutdown_keys.add(key)
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if (isinstance(arg, ast.Call)
+                        and _ctor_leaf(arg) in EXECUTOR_TYPES):
+                    handed_off.add(id(arg))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if (isinstance(expr, ast.Call)
+                        and _ctor_leaf(expr) in EXECUTOR_TYPES):
+                    handed_off.add(id(expr))
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Call):
+            bound_keys[id(node.value)] = [
+                _target_key(t) for t in node.targets]
+
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        ctor = _ctor_leaf(call)
+        if ctor not in EXECUTOR_TYPES or id(call) in handed_off:
+            continue
+        keys = [k for k in bound_keys.get(id(call), []) if k]
+        if any(k in shutdown_keys for k in keys):
+            continue
+        symbol = "%s:%s" % (ctor, keys[0] if keys else call.lineno)
+        findings.append(Finding(
+            RULE_ID, path, call.lineno, symbol,
+            "%s created with no shutdown path: call .shutdown() on it "
+            "from the owner's stop/close path, use it as a context "
+            "manager, or suppress naming who owns its lifecycle"
+            % ctor,
+        ))
+    return findings

@@ -1,0 +1,244 @@
+"""EL008 — RPC conformance: stubs, servicers, and the proto schema
+must agree.
+
+The image has no protoc: ``elastic_pb2.py`` comes from
+``scripts/gen_proto.py`` and the service method tables are registered
+BY HAND in ``proto/rpc.py``.  ``gen_proto.py --check`` guards the
+generated module against the EDITS list, but nothing guards the
+*users*: a client setting a field the message no longer has silently
+serializes nothing; a stub invoking a method the servicer never
+registered fails at runtime on the first elastic churn that exercises
+it; a servicer method nobody calls is dead wire protocol that still
+costs review.  This rule closes that triangle, whole-program:
+
+  - **unknown stub method** — ``stub.frobnicate(...)`` with no entry
+    in that service's method table;
+  - **wrong request type** — the argument's locally-inferred message
+    class differs from the registered request class;
+  - **unknown message field** — ``pb.X(field=...)`` kwargs, and
+    ``req.field`` reads/writes on locally-constructed messages,
+    checked against the fields parsed from ``elastic.proto`` itself
+    (plus ``pb.NAME`` references checked against message/enum names);
+  - **servicer drift** — a registered service method missing from the
+    matching ``*Servicer`` class (registration would crash at
+    startup), and a registered method no client stub ever invokes
+    (dead RPC surface, flagged on the method table).
+
+Message types are inferred only from local ``pb.X(...)`` construction
+— no guessing: an unrecognized receiver or argument is skipped, not
+reported.
+"""
+
+import os
+import re
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL008"
+
+_MESSAGE = re.compile(r"^\s*(message|enum)\s+(\w+)\s*\{")
+_FIELD = re.compile(
+    r"^\s*(?:repeated\s+)?(?:map\s*<[^>]+>\s+|[\w.]+\s+)(\w+)\s*=\s*\d+\s*;"
+)
+_ENUM_VALUE = re.compile(r"^\s*(\w+)\s*=\s*\d+\s*;")
+
+
+def parse_proto(text):
+    """elastic.proto -> ({message: {fields}}, {enum values∪names})."""
+    messages = {}
+    enums = set()
+    block = None      # (kind, name)
+    for line in text.splitlines():
+        stripped = line.split("//")[0]
+        m = _MESSAGE.match(stripped)
+        if m:
+            block = (m.group(1), m.group(2))
+            if block[0] == "message":
+                messages[block[1]] = set()
+            else:
+                enums.add(block[1])
+            continue
+        if "}" in stripped:
+            block = None
+            continue
+        if block is None:
+            continue
+        if block[0] == "message":
+            f = _FIELD.match(stripped)
+            if f:
+                messages[block[1]].add(f.group(1))
+        else:
+            v = _ENUM_VALUE.match(stripped)
+            if v:
+                enums.add(v.group(1))
+    return messages, enums
+
+
+def load_proto_fields(repo_root):
+    path = os.path.join(
+        repo_root or ".", "elasticdl_tpu", "proto", "elastic.proto")
+    if not os.path.isfile(path):
+        return None, None
+    with open(path, encoding="utf-8") as f:
+        return parse_proto(f.read())
+
+
+_DEFAULT_SERVICES_CACHE = {}
+
+
+def _load_default_services(repo_root):
+    """Single-module programs (check_source fixtures, partial scans)
+    don't include proto/rpc.py — fall back to the repo's real
+    hand-registered method tables so stub calls are still judged."""
+    if repo_root in _DEFAULT_SERVICES_CACHE:
+        return _DEFAULT_SERVICES_CACHE[repo_root]
+    import ast
+
+    from tools.elastic_lint.program import summarize_module
+
+    path = os.path.join(
+        repo_root or ".", "elasticdl_tpu", "proto", "rpc.py")
+    services, factories = {}, {}
+    if os.path.isfile(path):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        summary = summarize_module(
+            ast.parse(source), source, "elasticdl_tpu/proto/rpc.py")
+        services = summary.services
+        factories = dict(summary.stub_factories)
+    _DEFAULT_SERVICES_CACHE[repo_root] = (services, factories)
+    return services, factories
+
+
+def _service_for_stub(services, stub_factories, ctor_name):
+    """Stub ctor name -> (service name, method table) or None."""
+    svc = stub_factories.get(ctor_name)
+    if svc is None and ctor_name.endswith("Stub"):
+        prefix = ctor_name[: -len("Stub")].lower()
+        for name in services:
+            if name.rpartition(".")[2].lower() == prefix:
+                svc = name
+                break
+    if svc is None or svc not in services:
+        return None
+    return svc, services[svc]
+
+
+def _servicer_service(services, class_name):
+    """MasterServicer -> elasticdl_tpu.Master (name convention)."""
+    prefix = class_name[: -len("Servicer")].lower()
+    for name in services:
+        if name.rpartition(".")[2].lower() == prefix:
+            return name
+    return None
+
+
+def check_program(prog, proto_fields=None, proto_enums=None):
+    findings = []
+    if proto_fields is None:
+        proto_fields, proto_enums = load_proto_fields(prog.repo_root)
+    known_symbols = (
+        set(proto_fields or ()) | set(proto_enums or ()) | {"Empty"}
+    )
+    services, stub_factories = prog.services, prog.stub_factories
+    if not services:
+        services, stub_factories = _load_default_services(
+            prog.repo_root)
+
+    invoked = set()   # (service, method) with at least one call site
+    for modsum in prog.modules.values():
+        for (ctor, method, req_msg, line, qualname,
+             _future) in modsum.rpc_calls:
+            resolved = _service_for_stub(services, stub_factories,
+                                         ctor)
+            if resolved is None:
+                continue
+            svc, table = resolved
+            if method not in table:
+                findings.append(Finding(
+                    RULE_ID, modsum.path, line,
+                    "%s.%s" % (qualname, method),
+                    "stub call %s() is not a method of service %s "
+                    "(have: %s) — it will fail UNIMPLEMENTED at "
+                    "runtime" % (method, svc, ", ".join(sorted(table))),
+                ))
+                continue
+            invoked.add((svc, method))
+            want_req = table[method][0]
+            if (req_msg is not None and want_req is not None
+                    and req_msg != want_req):
+                findings.append(Finding(
+                    RULE_ID, modsum.path, line,
+                    "%s.%s" % (qualname, method),
+                    "stub call %s() sends %s but service %s registers "
+                    "request type %s — the server will fail to decode "
+                    "it" % (method, req_msg, svc, want_req),
+                ))
+
+        if proto_fields:
+            for msg, kwargs, line, qualname in modsum.msg_ctors:
+                fields = proto_fields.get(msg)
+                if fields is None:
+                    continue
+                for kw in kwargs:
+                    if kw not in fields:
+                        findings.append(Finding(
+                            RULE_ID, modsum.path, line,
+                            "%s.%s.%s" % (qualname, msg, kw),
+                            "unknown field %r in %s(...) — "
+                            "elastic.proto defines only [%s]"
+                            % (kw, msg, ", ".join(sorted(fields))),
+                        ))
+            for msg, field, line, qualname in modsum.msg_fields:
+                fields = proto_fields.get(msg)
+                if fields is None or field in fields:
+                    continue
+                findings.append(Finding(
+                    RULE_ID, modsum.path, line,
+                    "%s.%s.%s" % (qualname, msg, field),
+                    "access to unknown field %s.%s — elastic.proto "
+                    "defines only [%s]"
+                    % (msg, field, ", ".join(sorted(fields))),
+                ))
+            for symbol, line, qualname in modsum.pb_refs:
+                if symbol not in known_symbols:
+                    findings.append(Finding(
+                        RULE_ID, modsum.path, line,
+                        "%s.pb.%s" % (qualname, symbol),
+                        "pb.%s is neither a message nor an enum value "
+                        "in elastic.proto — schema drift"
+                        % symbol,
+                    ))
+
+    # servicer drift: registered methods must exist on the servicer
+    # class and must have at least one caller somewhere in the program.
+    servicer_methods = {}   # service -> (path, class, set(methods))
+    for modsum in prog.modules.values():
+        for cname, methods in modsum.servicers.items():
+            svc = _servicer_service(services, cname)
+            if svc is not None:
+                servicer_methods[svc] = (modsum.path, cname,
+                                         set(methods))
+    rpc_path = next(
+        (s.path for s in prog.modules.values() if s.services), None)
+    for svc, table in sorted(services.items()):
+        impl = servicer_methods.get(svc)
+        for method in sorted(table):
+            if impl is not None and method not in impl[2]:
+                findings.append(Finding(
+                    RULE_ID, impl[0], 0,
+                    "%s.%s" % (impl[1], method),
+                    "service %s registers %s() but servicer class %s "
+                    "does not define it — registration will crash at "
+                    "server startup" % (svc, method, impl[1]),
+                ))
+            if impl is not None and (svc, method) not in invoked:
+                findings.append(Finding(
+                    RULE_ID, rpc_path or impl[0], 0,
+                    "%s.%s" % (svc.rpartition(".")[2], method),
+                    "service method %s.%s has no client stub caller "
+                    "anywhere in the program — dead RPC surface "
+                    "(remove it or suppress naming the external "
+                    "caller)" % (svc, method),
+                ))
+    return findings

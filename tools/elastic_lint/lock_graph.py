@@ -1,0 +1,268 @@
+"""Interprocedural lock-acquisition-order graph (EL005's engine).
+
+Nodes are lock identities ``(module, class, attr)`` (class locks
+canonicalized to the class constructing the lock); a directed edge
+A -> B means some thread can acquire B while holding A — either
+lexically nested ``with`` blocks, or A held across a project-local
+call whose transitive callees acquire B.  A cycle among distinct
+locks is a potential ABBA deadlock; a self-edge on a non-reentrant
+``Lock`` is a guaranteed one.
+
+The same graph shape is produced by the runtime tracer's observed
+acquisition-order edges (``LockDisciplineTracer.lock_order_edges``),
+so static cycles can be confirmed or refuted by what test drills
+actually executed — see :func:`merge_observed`.
+
+Artifacts: :func:`to_dot` / :func:`to_json` render the graph for docs
+and CI (``--graph-out``); cycle edges are highlighted, and each edge
+carries its witness call chain.
+"""
+
+import json
+
+from tools.elastic_lint.program import lock_display
+
+
+class LockGraph:
+    def __init__(self):
+        self.nodes = {}   # display name -> lock kind ("Lock"/"RLock"/...)
+        self.edges = {}   # (src, dst) -> [witness strings]
+        self.observed = set()  # (src, dst) edges confirmed at runtime
+
+    def add_node(self, lock):
+        name = lock_display(lock)
+        if lock[3] is not None or name not in self.nodes:
+            self.nodes[name] = lock[3] or self.nodes.get(name)
+        return name
+
+    def add_edge(self, src_lock, dst_lock, witness):
+        src = self.add_node(src_lock)
+        dst = self.add_node(dst_lock)
+        sites = self.edges.setdefault((src, dst), [])
+        if witness not in sites and len(sites) < 8:
+            sites.append(witness)
+        return (src, dst)
+
+    # -- cycles ----------------------------------------------------------
+
+    def self_deadlocks(self):
+        """Self-edges on locks NOT known to be reentrant: acquiring a
+        plain Lock while holding it deadlocks the thread on itself."""
+        return sorted(
+            src for (src, dst) in self.edges
+            if src == dst and self.nodes.get(src) not in (
+                "RLock", "Condition")
+        )
+
+    def cycles(self):
+        """One representative cycle per non-trivial SCC, as a node
+        list ``[a, b, ..., a]`` rotated to start at the smallest node
+        (a stable signature for baselining)."""
+        succ = {}
+        for (src, dst) in self.edges:
+            if src != dst:
+                succ.setdefault(src, set()).add(dst)
+        sccs = _tarjan(set(self.nodes), succ)
+        out = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cycle = _find_cycle(sorted(scc), succ)
+            if cycle:
+                out.append(cycle)
+        return sorted(out)
+
+    def cycle_signature(self, cycle):
+        return "cycle:" + "->".join(cycle)
+
+    # -- runtime merge ---------------------------------------------------
+
+    def merge_observed(self, observed_edges):
+        """Mark static edges that the runtime tracer actually saw
+        (``observed_edges``: iterable of (src, dst) display names) and
+        add any runtime-only edges the static pass missed (e.g. via an
+        aliased lock object or a callback)."""
+        for src, dst in observed_edges:
+            self.nodes.setdefault(src, None)
+            self.nodes.setdefault(dst, None)
+            self.edges.setdefault((src, dst), []).append("<runtime>")
+            self.observed.add((src, dst))
+
+    def confirmed_cycles(self):
+        """Cycles whose EVERY edge was observed at runtime."""
+        out = []
+        for cycle in self.cycles():
+            pairs = list(zip(cycle, cycle[1:]))
+            if pairs and all(p in self.observed for p in pairs):
+                out.append(cycle)
+        return out
+
+    # -- artifacts -------------------------------------------------------
+
+    def to_json(self, baselined_signatures=()):
+        cycles = []
+        for cycle in self.cycles():
+            cycles.append({
+                "nodes": cycle,
+                "signature": self.cycle_signature(cycle),
+                "baselined": (self.cycle_signature(cycle)
+                              in set(baselined_signatures)),
+            })
+        return json.dumps({
+            "nodes": [
+                {"id": name, "kind": kind}
+                for name, kind in sorted(self.nodes.items())
+            ],
+            "edges": [
+                {"src": src, "dst": dst, "observed": (src, dst) in
+                 self.observed, "sites": sites}
+                for (src, dst), sites in sorted(self.edges.items())
+            ],
+            "cycles": cycles,
+            "self_deadlocks": self.self_deadlocks(),
+        }, indent=2, sort_keys=True)
+
+    def to_dot(self, baselined_signatures=()):
+        cycle_edges = set()
+        for cycle in self.cycles():
+            cycle_edges.update(zip(cycle, cycle[1:]))
+        lines = [
+            "// elastic-lint EL005 lock-order graph",
+            "// A -> B: some thread may acquire B while holding A.",
+            "// Red edges participate in a potential deadlock cycle.",
+            "digraph lock_order {",
+            "  rankdir=LR;",
+            "  node [shape=box, fontsize=10];",
+        ]
+        for name, kind in sorted(self.nodes.items()):
+            label = name + ("\\n(%s)" % kind if kind else "")
+            lines.append('  "%s" [label="%s"];' % (name, label))
+        for (src, dst), sites in sorted(self.edges.items()):
+            attrs = ['label="%s"' % _dot_escape(sites[0])] if sites else []
+            if (src, dst) in cycle_edges or src == dst:
+                attrs.append("color=red")
+            if (src, dst) in self.observed:
+                attrs.append("style=bold")
+            lines.append('  "%s" -> "%s" [%s];'
+                         % (src, dst, ", ".join(attrs)))
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+    def write(self, path, baselined_signatures=()):
+        if path.endswith(".json"):
+            payload = self.to_json(baselined_signatures)
+        else:
+            payload = self.to_dot(baselined_signatures)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(payload)
+
+
+def _dot_escape(text):
+    return text.replace('"', r'\"')
+
+
+def _tarjan(nodes, succ):
+    """Iterative Tarjan SCC (recursion-free: lint runs in CI)."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(succ.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for child in it:
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack.add(child)
+                    work.append(
+                        (child, iter(sorted(succ.get(child, ())))))
+                    advanced = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    scc.append(member)
+                    if member == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _find_cycle(scc_nodes, succ):
+    """A concrete cycle within one SCC, as [a, ..., a] starting at the
+    smallest member (deterministic signature)."""
+    start = scc_nodes[0]
+    members = set(scc_nodes)
+    # BFS from start back to start through SCC members only.
+    frontier = [(start, [start])]
+    seen = {start}
+    while frontier:
+        node, path = frontier.pop(0)
+        for child in sorted(succ.get(node, ())):
+            if child == start and node != start:
+                return path + [start]
+            if child in members and child not in seen:
+                seen.add(child)
+                frontier.append((child, path + [child]))
+    return None
+
+
+def build_graph(prog):
+    """Assemble the static lock-order graph for a Program (memoized —
+    the lint gate needs it twice: EL005 findings + the artifact)."""
+    if prog._lock_graph_cache is not None:
+        return prog._lock_graph_cache
+    graph = LockGraph()
+    may_acquire = prog.may_acquire()
+    for fid, (modsum, _, fsum) in prog.functions.items():
+        for lockref, _ in fsum.acquires:
+            graph.add_node(prog.resolve_lock(fid, lockref))
+        for outer, inner, line in fsum.edges:
+            graph.add_edge(
+                prog.resolve_lock(fid, outer),
+                prog.resolve_lock(fid, inner),
+                "%s:%d" % (fsum.qualname, line),
+            )
+    calls = prog._resolve_all_calls()
+    for fid, out in calls.items():
+        _, _, fsum = prog.functions[fid]
+        for callee, line, held, _ in out:
+            if not held:
+                continue
+            for lock, _ in may_acquire.get(callee, {}).items():
+                for href in held:
+                    hlock = prog.resolve_lock(fid, href)
+                    if hlock[:3] == lock[:3]:
+                        if lock[3] in ("RLock", "Condition"):
+                            continue  # reentrant re-acquire is legal
+                    graph.add_edge(
+                        hlock, lock,
+                        "%s:%d -> %s" % (
+                            fsum.qualname, line,
+                            prog.chain(callee, lock,
+                                       may_acquire)),
+                    )
+    prog._lock_graph_cache = graph
+    return graph

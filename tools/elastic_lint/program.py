@@ -1,0 +1,837 @@
+"""Whole-program model for elastic-lint's interprocedural rules.
+
+elastic-lint v1 (EL001-EL004) judges each file in isolation; the bug
+classes PRs 2-3 hand-hunted — a lock-order inversion spanning two
+classes, an RPC issued three calls below a ``with self._lock`` — are
+invisible at that granularity.  This module builds the cross-file
+model those rules (EL005/EL006/EL008) need:
+
+  - every module is reduced to a pickleable :class:`ModuleSummary`
+    (so ``--jobs N`` can farm per-file work to worker processes and
+    ship summaries home cheaply);
+  - :class:`Program` stitches the summaries together: a project-local
+    call graph (``self.method``, ``module.func``, typed ``self._attr``
+    calls), lock identities ``(module, class, attr)`` canonicalized to
+    the class that CONSTRUCTS the lock, and fixpoints for "locks this
+    call may acquire" / "blocking ops this call may reach".
+
+Attribute types come from three sources, in order: constructor calls
+(``self._x = Queue()``), ``__init__`` parameter names (``self._tm =
+task_manager`` resolves to class ``TaskManager`` when exactly one such
+class exists — the repo names parameters after their classes), and
+the attribute's own name as a last resort.  Unresolvable calls are
+dropped, never guessed: the rules stay quiet rather than cry wolf.
+
+Scope limits (deliberate, documented): nested ``def``/``lambda``
+bodies are skipped (their execution time is unknowable statically —
+an executor may run them with no lock held); a lock object aliased
+across two attributes (serving's shared execute lock) is two static
+identities, unified only by the runtime tracer; calls through bare
+callbacks (``self._factory(...)``) are unresolved; and a bare
+``lock.acquire()``/``release()`` pair does NOT establish a held
+region (its extent is not lexically scoped) — the acquire is recorded
+as a graph node only, so code between acquire and release is blind to
+EL005 edges and EL006.  This repo takes locks exclusively via
+``with``; keep it that way, or lean on the runtime tracer for a
+bare-acquire path.
+"""
+
+import ast
+import os
+import re
+
+from tools.elastic_lint import blocking
+from tools.elastic_lint.suppressions import _PRAGMA, _pragma_rules
+
+LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+PB_MESSAGE_API = {
+    "SerializeToString", "FromString", "ByteSize", "CopyFrom", "Clear",
+    "ClearField", "HasField", "WhichOneof", "IsInitialized", "MergeFrom",
+    "MergeFromString", "ListFields", "SetInParent", "DESCRIPTOR",
+}
+
+
+def _snake(name):
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+def _dotted_ctor(func):
+    """'Foo' / 'mod.Foo' for a call's func node, else None."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Pickleable summaries
+# ---------------------------------------------------------------------------
+
+
+class FuncSummary:
+    __slots__ = ("name", "qualname", "line", "assume_locked", "acquires",
+                 "edges", "calls", "blocking")
+
+    def __init__(self, name, qualname, line, assume_locked):
+        self.name = name
+        self.qualname = qualname          # Class.method or func
+        self.line = line
+        self.assume_locked = assume_locked
+        self.acquires = []   # [(lockref, line)]
+        self.edges = []      # [(outer lockref, inner lockref, line)]
+        self.calls = []      # [(callref, line, held lockref tuple)]
+        self.blocking = []   # [(desc, line, held lockref tuple)]
+
+
+class ClassSummary:
+    __slots__ = ("name", "line", "bases", "methods", "lock_attrs",
+                 "attr_types", "init_params")
+
+    def __init__(self, name, line):
+        self.name = name
+        self.line = line
+        self.bases = []
+        self.methods = {}     # name -> FuncSummary
+        self.lock_attrs = {}  # attr -> "Lock" | "RLock" | "Condition" | None
+        self.attr_types = {}  # attr -> ("ctor"|"ctorlist"|"param", name)
+        self.init_params = ()
+
+
+class ModuleSummary:
+    __slots__ = ("path", "modname", "imports", "classes", "functions",
+                 "global_locks", "pragmas", "msg_ctors", "msg_fields",
+                 "pb_refs", "rpc_calls", "services", "stub_factories",
+                 "servicers", "thread_sites")
+
+    def __init__(self, path, modname):
+        self.path = path
+        self.modname = modname
+        self.imports = {}       # local name -> dotted target
+        self.classes = {}       # name -> ClassSummary
+        self.functions = {}     # name -> FuncSummary
+        self.global_locks = {}  # NAME -> lock kind
+        self.pragmas = {}       # line -> (frozenset(rules), has_reason)
+        # EL008 raw material
+        self.msg_ctors = []     # [(msg, kwargs tuple, line, qualname)]
+        self.msg_fields = []    # [(msg, field, line, qualname)]
+        self.pb_refs = []       # [(symbol, line, qualname)]
+        self.rpc_calls = []     # [(stub ctor, method, req msg|None,
+                                #   line, qualname, via_future)]
+        self.services = {}      # service -> {method: (req, res)}
+        self.stub_factories = {}  # assigned name -> service
+        self.servicers = {}     # class -> [rpc method names]
+        self.thread_sites = []  # [(ctor, line)] (EL007 cross-checks)
+
+
+# ---------------------------------------------------------------------------
+# Per-module summarizer
+# ---------------------------------------------------------------------------
+
+def _collect_pragmas(source):
+    """line -> (rules, has_reason), reusing suppressions' ONE pragma
+    parser so per-file and whole-program rules can never drift on
+    what counts as a valid ``# elint: disable=`` comment."""
+    pragmas = {}
+    for lineno, line in enumerate(source.splitlines(), 1):
+        if _PRAGMA.search(line) is None:
+            continue
+        rules, has_reason = _pragma_rules(line)
+        pragmas[lineno] = (frozenset(rules), has_reason)
+    return pragmas
+
+
+def _value_type(value, pb_aliases, local_types):
+    """Infer ('ctor'|'ctorlist'|'msg', Name) for an assigned value."""
+    if isinstance(value, ast.Call):
+        dotted = _dotted_ctor(value.func)
+        if dotted is None:
+            return None
+        base, _, leaf = dotted.rpartition(".")
+        if base and base in pb_aliases:
+            return ("msg", leaf)
+        return ("ctor", leaf)
+    if isinstance(value, (ast.ListComp, ast.GeneratorExp)):
+        elt = _value_type(value.elt, pb_aliases, local_types)
+        if elt is not None and elt[0] == "ctor":
+            return ("ctorlist", elt[1])
+        return None
+    if isinstance(value, ast.IfExp):
+        return (_value_type(value.body, pb_aliases, local_types)
+                or _value_type(value.orelse, pb_aliases, local_types))
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            t = _value_type(v, pb_aliases, local_types)
+            if t is not None:
+                return t
+        return None
+    if isinstance(value, ast.Name):
+        return local_types.get(value.id)
+    return None
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """One pass over a function body: lock regions, call sites,
+    blocking ops, pb message usage.  Maintains the held-lock stack so
+    every recorded event knows what locks guard it."""
+
+    def __init__(self, modsum, clssum, fsum, pb_aliases, stubish):
+        self._mod = modsum
+        self._cls = clssum
+        self._f = fsum
+        self._pb = pb_aliases
+        self._stubish = stubish     # names known to construct stubs
+        self._held = []
+        self._local_types = {}      # name -> ("ctor"|"msg"|..., Name)
+        if fsum.assume_locked and clssum is not None:
+            primary = [a for a, k in clssum.lock_attrs.items()
+                       if k in ("Lock", "RLock")]
+            if len(primary) == 1:
+                self._held.append(("self", primary[0]))
+
+    # -- type oracle ---------------------------------------------------
+
+    def _type_of(self, node):
+        if isinstance(node, ast.Name):
+            t = self._local_types.get(node.id)
+        elif (isinstance(node, ast.Attribute)
+              and isinstance(node.value, ast.Name)
+              and node.value.id == "self" and self._cls is not None):
+            t = self._cls.attr_types.get(node.attr)
+            if t is not None and t[0] == "param":
+                return None
+        elif isinstance(node, ast.Subscript):
+            t = self._type_of(node.value)
+            if t is not None and t[0] == "ctorlist":
+                t = ("ctor", t[1])
+        else:
+            t = None
+        if t is not None and t[0] == "ctor" and (
+                t[1] in self._stubish or t[1].endswith("Stub")):
+            return ("stub", t[1])
+        return t
+
+    # -- lock regions --------------------------------------------------
+
+    def _lockref(self, expr):
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and self._cls is not None
+                and expr.attr in self._cls.lock_attrs):
+            return ("self", expr.attr)
+        if (isinstance(expr, ast.Name)
+                and expr.id in self._mod.global_locks):
+            return ("global", expr.id)
+        return None
+
+    def _acquire(self, lockref, line):
+        for outer in self._held:
+            self._f.edges.append((outer, lockref, line))
+        self._f.acquires.append((lockref, line))
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            lockref = self._lockref(item.context_expr)
+            if lockref is not None:
+                self._acquire(lockref, item.context_expr.lineno)
+                self._held.append(lockref)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- nested defs: execution time unknown, skip ---------------------
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- assignments: local type inference + pb field writes -----------
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        t = _value_type(node.value, self._pb, self._local_types)
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                if t is not None:
+                    self._local_types[target.id] = t
+            else:
+                self.visit(target)
+
+    def visit_comprehension_generators(self, generators):
+        for gen in generators:
+            self.visit(gen.iter)
+            t = self._type_of(gen.iter)
+            if (t is not None and t[0] in ("ctorlist",)
+                    and isinstance(gen.target, ast.Name)):
+                self._local_types[gen.target.id] = ("ctor", t[1])
+            for cond in gen.ifs:
+                self.visit(cond)
+
+    def visit_ListComp(self, node):
+        self.visit_comprehension_generators(node.generators)
+        self.visit(node.elt)
+
+    visit_SetComp = visit_ListComp
+    visit_GeneratorExp = visit_ListComp
+
+    def visit_DictComp(self, node):
+        self.visit_comprehension_generators(node.generators)
+        self.visit(node.key)
+        self.visit(node.value)
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        t = self._type_of(node.iter)
+        if (t is not None and t[0] == "ctorlist"
+                and isinstance(node.target, ast.Name)):
+            self._local_types[node.target.id] = ("ctor", t[1])
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    # -- pb message field accesses -------------------------------------
+
+    def visit_Attribute(self, node):
+        value = node.value
+        if isinstance(value, ast.Name):
+            t = self._local_types.get(value.id)
+            if (t is not None and t[0] == "msg"
+                    and node.attr not in PB_MESSAGE_API):
+                self._mod.msg_fields.append(
+                    (t[1], node.attr, node.lineno, self._f.qualname))
+            elif value.id in self._pb and isinstance(node.ctx, ast.Load):
+                self._mod.pb_refs.append(
+                    (node.attr, node.lineno, self._f.qualname))
+        self.generic_visit(node)
+
+    # -- calls ----------------------------------------------------------
+
+    def _callref(self, func):
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return ("self", func.attr)
+            return ("dotted", base.id, func.attr)
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            return ("selfattr", base.attr, func.attr)
+        return None
+
+    def _first_arg_msg(self, call):
+        if not call.args:
+            return None
+        t = _value_type(call.args[0], self._pb, self._local_types)
+        if t is not None and t[0] == "msg":
+            return t[1]
+        return None
+
+    def _record_rpc(self, method, receiver, call, via_future):
+        t = self._type_of(receiver)
+        if t is not None and t[0] == "stub":
+            self._mod.rpc_calls.append((
+                t[1], method, self._first_arg_msg(call),
+                call.lineno, self._f.qualname, via_future,
+            ))
+            return True
+        return False
+
+    def visit_Call(self, node):
+        func = node.func
+        # RPC stub invocations: stub.m(req) and stub.m.future(req)
+        if isinstance(func, ast.Attribute):
+            if (func.attr == "future"
+                    and isinstance(func.value, ast.Attribute)):
+                self._record_rpc(
+                    func.value.attr, func.value.value, node,
+                    via_future=True)
+            elif not self._record_rpc(func.attr, func.value, node,
+                                      via_future=False):
+                pass
+            # bare .acquire() on a recognized lock
+            if func.attr == "acquire":
+                lockref = self._lockref(func.value)
+                if lockref is not None:
+                    self._acquire(lockref, node.lineno)
+        # pb message constructors
+        dotted = _dotted_ctor(func)
+        if dotted is not None and "." in dotted:
+            base, _, leaf = dotted.rpartition(".")
+            if base in self._pb:
+                kwargs = tuple(kw.arg for kw in node.keywords
+                               if kw.arg is not None)
+                self._mod.msg_ctors.append(
+                    (leaf, kwargs, node.lineno, self._f.qualname))
+        # blocking registry
+        desc = blocking.classify_call(node, self._type_of)
+        if desc is not None:
+            self._f.blocking.append(
+                (desc, node.lineno, tuple(self._held)))
+        # project-local call edge
+        callref = self._callref(func)
+        if callref is not None:
+            self._f.calls.append(
+                (callref, node.lineno, tuple(self._held)))
+        self.generic_visit(node)
+
+
+def _class_prepass(cls, modsum, pb_aliases):
+    """lock_attrs + attr_types + init params for one class."""
+    summary = ClassSummary(cls.name, cls.lineno)
+    summary.bases = [_dotted_ctor(b) or "" for b in cls.bases]
+    init = None
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            init = node
+    if init is not None:
+        summary.init_params = tuple(
+            a.arg for a in init.args.args if a.arg != "self")
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(method):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if (isinstance(expr, ast.Attribute)
+                            and isinstance(expr.value, ast.Name)
+                            and expr.value.id == "self"
+                            and "lock" in expr.attr.lower()):
+                        summary.lock_attrs.setdefault(expr.attr, None)
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                attr = target.attr
+                value = node.value
+                ctor = None
+                if isinstance(value, ast.Call):
+                    ctor = _dotted_ctor(value.func)
+                    ctor = ctor.rpartition(".")[2] if ctor else None
+                if ctor in LOCK_CTORS:
+                    summary.lock_attrs[attr] = LOCK_CTORS[ctor]
+                    continue
+                if "lock" in attr.lower():
+                    # e.g. `self._exec_lock = execute_lock or Lock()`
+                    summary.lock_attrs.setdefault(attr, None)
+                    continue
+                t = _value_type(value, pb_aliases, {})
+                if t is not None:
+                    summary.attr_types.setdefault(attr, t)
+                elif (method.name == "__init__"
+                      and isinstance(value, ast.Name)
+                      and value.id in summary.init_params):
+                    summary.attr_types.setdefault(
+                        attr, ("param", value.id))
+    return summary
+
+
+def _extract_services(node):
+    """Parse a literal ``SERVICES = {...}`` dict (proto/rpc.py)."""
+    services = {}
+    if not isinstance(node.value, ast.Dict):
+        return services
+    for key, value in zip(node.value.keys, node.value.values):
+        if not (isinstance(key, ast.Constant)
+                and isinstance(key.value, str)
+                and isinstance(value, ast.Dict)):
+            continue
+        table = {}
+        for mkey, mval in zip(value.keys, value.values):
+            if not (isinstance(mkey, ast.Constant)
+                    and isinstance(mkey.value, str)):
+                continue
+            req = res = None
+            if isinstance(mval, ast.Tuple) and len(mval.elts) == 2:
+                req = _dotted_ctor(mval.elts[0])
+                res = _dotted_ctor(mval.elts[1])
+                req = req.rpartition(".")[2] if req else None
+                res = res.rpartition(".")[2] if res else None
+            table[mkey.value] = (req, res)
+        services[key.value] = table
+    return services
+
+
+def summarize_module(tree, source, path, modname=None):
+    """Reduce one parsed module to a pickleable ModuleSummary."""
+    if modname is None:
+        modname = (path[:-3] if path.endswith(".py") else path).replace(
+            "/", ".").replace(os.sep, ".")
+    modsum = ModuleSummary(path, modname)
+    modsum.pragmas = _collect_pragmas(source)
+
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                modsum.imports[local] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            prefix = node.module
+            if node.level:
+                parts = modname.split(".")[: -node.level]
+                prefix = ".".join(parts + [node.module])
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                modsum.imports[alias.asname or alias.name] = (
+                    prefix + "." + alias.name)
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                ctor = (_dotted_ctor(node.value.func)
+                        if isinstance(node.value, ast.Call) else None)
+                leaf = ctor.rpartition(".")[2] if ctor else None
+                if leaf in LOCK_CTORS:
+                    modsum.global_locks[target.id] = LOCK_CTORS[leaf]
+                elif target.id == "SERVICES":
+                    modsum.services = _extract_services(node)
+                elif (isinstance(node.value, ast.Call)
+                      and ctor == "_make_stub_class"
+                      and node.value.args
+                      and isinstance(node.value.args[0], ast.Constant)):
+                    modsum.stub_factories[target.id] = (
+                        node.value.args[0].value)
+
+    pb_aliases = {
+        local for local, target in modsum.imports.items()
+        if target.endswith("elastic_pb2")
+    }
+    stubish = set(modsum.stub_factories) | {
+        local for local, target in modsum.imports.items()
+        if target.rpartition(".")[2].endswith("Stub")
+    }
+
+    def scan_function(func, clssum, qualname):
+        fsum = FuncSummary(
+            func.name, qualname, func.lineno,
+            assume_locked=func.name.endswith("_locked"))
+        scanner = _FuncScanner(modsum, clssum, fsum, pb_aliases, stubish)
+        for stmt in func.body:
+            scanner.visit(stmt)
+        return fsum
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            modsum.functions[node.name] = scan_function(
+                node, None, node.name)
+        elif isinstance(node, ast.ClassDef):
+            clssum = _class_prepass(node, modsum, pb_aliases)
+            modsum.classes[node.name] = clssum
+            for method in node.body:
+                if isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    clssum.methods[method.name] = scan_function(
+                        method, clssum,
+                        "%s.%s" % (node.name, method.name))
+            if node.name.endswith("Servicer"):
+                modsum.servicers[node.name] = [
+                    m.name for m in node.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and not m.name.startswith("_")
+                    and len(m.args.args) >= 2
+                    and m.args.args[1].arg == "request"
+                ]
+    for call in ast.walk(tree):
+        if isinstance(call, ast.Call):
+            ctor = _dotted_ctor(call.func)
+            leaf = ctor.rpartition(".")[2] if ctor else None
+            if leaf in ("Thread", "Timer", "ThreadPoolExecutor",
+                        "ProcessPoolExecutor"):
+                modsum.thread_sites.append((leaf, call.lineno))
+    return modsum
+
+
+# ---------------------------------------------------------------------------
+# Program: the stitched whole
+# ---------------------------------------------------------------------------
+
+
+class Program:
+    def __init__(self, summaries, repo_root=None):
+        self.repo_root = repo_root
+        self.modules = {s.modname: s for s in summaries}
+        self.by_path = {s.path: s for s in summaries}
+        self.pragmas_by_path = {s.path: s.pragmas for s in summaries}
+        self.services = {}
+        self.stub_factories = {}
+        for s in summaries:
+            self.services.update(s.services)
+            for name, svc in s.stub_factories.items():
+                self.stub_factories[s.modname + "." + name] = svc
+                self.stub_factories.setdefault(name, svc)
+        # class indexes
+        self._classes = {}
+        self._snake = {}
+        for s in summaries:
+            for cname, csum in s.classes.items():
+                self._classes[(s.modname, cname)] = csum
+                self._snake.setdefault(_snake(cname), []).append(
+                    (s.modname, cname))
+        # function table: fid -> (modsum, clssum|None, fsum)
+        self.functions = {}
+        for s in summaries:
+            for fname, fsum in s.functions.items():
+                self.functions[(s.modname, None, fname)] = (s, None, fsum)
+            for cname, csum in s.classes.items():
+                for mname, fsum in csum.methods.items():
+                    self.functions[(s.modname, cname, mname)] = (
+                        s, csum, fsum)
+        self._may_acquire = None
+        self._may_block = None
+        self._resolved_calls = None
+        # memoized by lock_graph.build_graph: the gate builds the
+        # graph for EL005 findings AND the --graph-out artifact.
+        self._lock_graph_cache = None
+
+    # -- name resolution -----------------------------------------------
+
+    def _resolve_dotted(self, modsum, dotted):
+        """'Name' or 'alias.Name' -> (modname, Name) of a program
+        class/function, else None."""
+        base, _, leaf = dotted.rpartition(".")
+        if base:
+            target = modsum.imports.get(base)
+            if target is None:
+                return None
+            if target in self.modules:
+                return (target, leaf)
+            return None
+        target = modsum.imports.get(leaf)
+        if target is not None:
+            tmod, _, tleaf = target.rpartition(".")
+            if tmod in self.modules:
+                return (tmod, tleaf)
+            if target in self.modules:
+                return (target, None)
+            return None
+        if leaf in modsum.classes or leaf in modsum.functions:
+            return (modsum.modname, leaf)
+        return None
+
+    def _find_class(self, modname, cname):
+        return self._classes.get((modname, cname))
+
+    def _class_by_hint(self, hint):
+        """Unique program class whose snake_case name == hint."""
+        hits = self._snake.get(hint, ())
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def _resolve_attr_class(self, modsum, clssum, attr):
+        """Owning (modname, Class) for a typed self-attribute."""
+        t = clssum.attr_types.get(attr)
+        if t is not None and t[0] in ("ctor", "ctorlist"):
+            hit = self._resolve_dotted(modsum, t[1])
+            if hit is not None and hit[1] is not None and (
+                    self._find_class(*hit) is not None):
+                return hit
+            return None
+        if t is not None and t[0] == "param":
+            return self._class_by_hint(t[1])
+        return self._class_by_hint(_snake(attr.lstrip("_")))
+
+    def _method_in(self, modname, cname, method, _depth=0):
+        """(modname, cname, method) walking base classes."""
+        if _depth > 4:
+            return None
+        csum = self._find_class(modname, cname)
+        if csum is None:
+            return None
+        if method in csum.methods:
+            return (modname, cname, method)
+        modsum = self.modules[modname]
+        for base in csum.bases:
+            hit = self._resolve_dotted(modsum, base) if base else None
+            if hit is not None and hit[1] is not None:
+                found = self._method_in(hit[0], hit[1], method,
+                                        _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def resolve_call(self, fid, callref):
+        """callref (from a FuncSummary) -> callee fid or None."""
+        modname, cname, _ = fid
+        modsum = self.modules[modname]
+        kind = callref[0]
+        if kind == "self" and cname is not None:
+            return self._method_in(modname, cname, callref[1])
+        if kind == "selfattr" and cname is not None:
+            clssum = self._find_class(modname, cname)
+            owner = self._resolve_attr_class(modsum, clssum, callref[1])
+            if owner is None:
+                return None
+            return self._method_in(owner[0], owner[1], callref[2])
+        if kind == "name":
+            hit = self._resolve_dotted(modsum, callref[1])
+            if hit is None or hit[1] is None:
+                return None
+            tmod, leaf = hit
+            tsum = self.modules.get(tmod)
+            if tsum is None:
+                return None
+            if leaf in tsum.functions:
+                return (tmod, None, leaf)
+            if leaf in tsum.classes:
+                return self._method_in(tmod, leaf, "__init__")
+            return None
+        if kind == "dotted":
+            target = modsum.imports.get(callref[1])
+            if target in self.modules:
+                tsum = self.modules[target]
+                if callref[2] in tsum.functions:
+                    return (target, None, callref[2])
+                if callref[2] in tsum.classes:
+                    return self._method_in(target, callref[2],
+                                           "__init__")
+            return None
+        return None
+
+    # -- lock identity ---------------------------------------------------
+
+    def resolve_lock(self, fid, lockref):
+        """lockref -> (module, class, attr, kind) canonical identity.
+
+        Class locks canonicalize to the class that CONSTRUCTS the lock
+        (walking bases), so a subclass's ``with self._lock`` and the
+        base's agree on one node."""
+        modname, cname, _ = fid
+        if lockref[0] == "global":
+            kind = self.modules[modname].global_locks.get(lockref[1])
+            return (modname, "", lockref[1], kind)
+        attr = lockref[1]
+        owner_mod, owner_cls = modname, cname
+        seen = 0
+        while seen < 5:
+            csum = self._find_class(owner_mod, owner_cls)
+            if csum is None:
+                break
+            kind = csum.lock_attrs.get(attr)
+            if kind is not None:
+                return (owner_mod, owner_cls, attr, kind)
+            parent = None
+            for base in csum.bases:
+                hit = (self._resolve_dotted(self.modules[owner_mod], base)
+                       if base else None)
+                if hit is not None and hit[1] is not None and (
+                        self._find_class(*hit) is not None):
+                    bsum = self._find_class(*hit)
+                    if attr in bsum.lock_attrs:
+                        parent = hit
+                        break
+            if parent is None:
+                break
+            owner_mod, owner_cls = parent
+            seen += 1
+        return (modname, cname or "", attr, None)
+
+    # -- fixpoints -------------------------------------------------------
+
+    def _resolve_all_calls(self):
+        if self._resolved_calls is not None:
+            return self._resolved_calls
+        resolved = {}
+        for fid, (_, _, fsum) in self.functions.items():
+            out = []
+            for callref, line, held in fsum.calls:
+                callee = self.resolve_call(fid, callref)
+                if callee is not None and callee != fid:
+                    out.append((callee, line, held, callref))
+            resolved[fid] = out
+        self._resolved_calls = resolved
+        return resolved
+
+    def _fixpoint(self, direct_of):
+        """Propagate {key: (first_step_fid|None, line)} maps up the
+        call graph to a fixpoint.  ``direct_of(fid, fsum)`` yields
+        (key, line) pairs for facts originating in ``fid``."""
+        facts = {}
+        for fid, (_, _, fsum) in self.functions.items():
+            facts[fid] = {}
+            for key, line in direct_of(fid, fsum):
+                facts[fid].setdefault(key, (None, line))
+        calls = self._resolve_all_calls()
+        callers = {}
+        for fid, out in calls.items():
+            for callee, line, _, _ in out:
+                callers.setdefault(callee, []).append((fid, line))
+        work = [fid for fid in self.functions if facts[fid]]
+        while work:
+            fid = work.pop()
+            for caller, line in callers.get(fid, ()):
+                updated = False
+                for key in facts[fid]:
+                    if key not in facts[caller]:
+                        facts[caller][key] = (fid, line)
+                        updated = True
+                if updated:
+                    work.append(caller)
+        return facts
+
+    def may_acquire(self):
+        """fid -> {lock id: (first callee fid|None, line)}."""
+        if self._may_acquire is None:
+            def direct(fid, fsum):
+                for lockref, line in fsum.acquires:
+                    yield self.resolve_lock(fid, lockref), line
+            self._may_acquire = self._fixpoint(direct)
+        return self._may_acquire
+
+    def may_block(self):
+        """fid -> {blocking desc: (first callee fid|None, line)}."""
+        if self._may_block is None:
+            def direct(fid, fsum):
+                for desc, line, _ in fsum.blocking:
+                    yield desc, line
+            self._may_block = self._fixpoint(direct)
+        return self._may_block
+
+    def chain(self, fid, key, facts, limit=6):
+        """Human call chain from fid to the fact's origin."""
+        parts = []
+        current = fid
+        while current is not None and limit > 0:
+            _, _, fsum = self.functions[current]
+            step, line = facts[current][key]
+            parts.append("%s:%d" % (fsum.qualname, line))
+            if step is None:
+                break
+            current = step
+            limit -= 1
+        return " -> ".join(parts)
+
+    def qualname(self, fid):
+        return self.functions[fid][2].qualname
+
+
+def lock_display(lock):
+    """(module, class, attr, kind) -> 'module.Class.attr'."""
+    mod, cls, attr = lock[0], lock[1], lock[2]
+    return ".".join(p for p in (mod, cls, attr) if p)

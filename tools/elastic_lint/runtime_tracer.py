@@ -1,8 +1,15 @@
-"""ThreadSanitizer-lite: runtime lock-discipline tracing.
+"""ThreadSanitizer-lite: runtime lock-discipline AND lock-order tracing.
 
-The static half (EL001) proves lock discipline for accesses it can see;
-this module catches what static analysis cannot — accesses through
-callbacks, subclasses, or foreign modules.  Register a shared object
+The static halves (EL001 discipline, EL005 lock-order) prove what they
+can see; this module catches what static analysis cannot — accesses
+through callbacks, subclasses, foreign modules, or two attributes
+aliasing ONE lock object.  Every acquisition of a registered lock
+while the thread holds other registered locks records an observed
+``held -> acquired`` order edge (``lock_order_edges()``); cycles among
+observed edges (``order_violations()``/``assert_ordered()``) mean the
+test run itself exercised both sides of an ABBA ordering, and the
+edges merge into EL005's static graph to confirm or refute its cycles
+(``lock_graph.LockGraph.merge_observed``).  Register a shared object
 and the attributes its lock guards; while the tracer is active, every
 read/write of those attributes is recorded together with whether the
 object's lock was held by the accessing thread.  ``violations()``
@@ -33,10 +40,16 @@ _SELF_SYNC = (threading.Event, threading.Condition, threading.Semaphore)
 
 
 class TrackedLock:
-    """Wraps a Lock/RLock, recording which threads currently hold it."""
+    """Wraps a Lock/RLock, recording which threads currently hold it
+    and (when owned by a tracer) reporting acquisition-ORDER edges:
+    acquiring this lock while the thread already holds others yields
+    one ``held -> this`` edge per held lock — the runtime half of
+    EL005's static lock-order graph."""
 
-    def __init__(self, inner):
+    def __init__(self, inner, label=None, tracer=None):
         self._inner = inner
+        self.label = label or ("lock@%x" % id(inner))
+        self._tracer = tracer
         self._holders = {}  # thread ident -> recursion depth
 
     def acquire(self, *args, **kwargs):
@@ -44,6 +57,8 @@ class TrackedLock:
         if acquired:
             ident = threading.get_ident()
             self._holders[ident] = self._holders.get(ident, 0) + 1
+            if self._tracer is not None:
+                self._tracer._on_acquire(self)
         return acquired
 
     def release(self):
@@ -53,6 +68,8 @@ class TrackedLock:
             self._holders.pop(ident, None)
         else:
             self._holders[ident] = depth - 1
+        if self._tracer is not None:
+            self._tracer._on_release(self)
         self._inner.release()
 
     def __enter__(self):
@@ -72,10 +89,47 @@ class TrackedLock:
 
 class LockDisciplineTracer:
     def __init__(self):
-        # list.append is GIL-atomic, so concurrent recorders need no
-        # lock of their own (and must not take the traced one).
+        # list.append / set.add are GIL-atomic, so concurrent recorders
+        # need no lock of their own (and must not take the traced one).
         self.events = []
         self._restores = []
+        # acquisition-order edges: (held label, acquired label) pairs
+        # actually executed by some thread — the observed counterpart
+        # of EL005's static graph.
+        self.order_edges = set()
+        self._held = threading.local()
+
+    # -- lock-order recording -----------------------------------------
+
+    def _stack(self):
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _on_acquire(self, lock):
+        stack = self._stack()
+        for held in stack:
+            if held.label != lock.label:
+                self.order_edges.add((held.label, lock.label))
+        stack.append(lock)
+
+    def _on_release(self, lock):
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                break
+
+    def register_lock(self, lock, label):
+        """Wrap a bare Lock/RLock so its acquisition ORDER relative to
+        other registered locks is observed (no attribute tracking).
+        Returns the wrapper — use it in place of the original."""
+        if isinstance(lock, TrackedLock):
+            lock._tracer = self
+            lock.label = label
+            return lock
+        return TrackedLock(lock, label=label, tracer=self)
 
     # -- instrumentation ----------------------------------------------
 
@@ -87,9 +141,12 @@ class LockDisciplineTracer:
         Semaphore/queues).  Call before handing the object to worker
         threads."""
         lock = getattr(obj, lock_attr)
+        label = "%s.%s" % (type(obj).__name__, lock_attr)
         if not isinstance(lock, TrackedLock):
-            lock = TrackedLock(lock)
+            lock = TrackedLock(lock, label=label, tracer=self)
             object.__setattr__(obj, lock_attr, lock)
+        else:
+            lock._tracer = self
         if attrs is None:
             attrs = [
                 name for name, value in vars(obj).items()
@@ -172,6 +229,32 @@ class LockDisciplineTracer:
             raise AssertionError(
                 "unsynchronized cross-thread access:\n" + "\n".join(
                     "  %s.%s: %s" % p for p in problems))
+
+    # -- lock-order reporting ------------------------------------------
+
+    def lock_order_edges(self):
+        """Observed (held label, acquired label) pairs — merge into a
+        static ``lock_graph.LockGraph`` via ``merge_observed`` to
+        confirm or refute EL005's cycles against what actually ran."""
+        return set(self.order_edges)
+
+    def order_violations(self):
+        """Cycles among the OBSERVED acquisition-order edges: each is
+        a label list ``[a, b, ..., a]``.  A cycle here means the test
+        run itself exercised both sides of an ABBA ordering — a real
+        deadlock waiting on unlucky scheduling."""
+        from tools.elastic_lint.lock_graph import LockGraph
+
+        graph = LockGraph()
+        graph.merge_observed(self.order_edges)
+        return graph.cycles()
+
+    def assert_ordered(self):
+        cycles = self.order_violations()
+        if cycles:
+            raise AssertionError(
+                "lock-order cycles observed at runtime:\n" + "\n".join(
+                    "  " + " -> ".join(c) for c in cycles))
 
 
 def _make_getattribute(tracked, record):

@@ -58,11 +58,17 @@ def apply_inline(findings, source):
 
 def load_baseline(path):
     """Parse baseline lines ``RULE path symbol -- reason`` into a set of
-    (rule, path, symbol) keys.  Unparseable or reason-less lines raise:
-    a broken baseline must fail the lint run, not silently allow."""
+    (rule, path, symbol) keys.  Unparseable or reason-less lines raise,
+    and so does an explicitly-passed path that does not exist: a broken
+    or missing baseline must fail the lint run, not silently allow
+    (``None`` means "no baseline", deliberately)."""
     entries = set()
-    if not path or not os.path.isfile(path):
+    if not path:
         return entries
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            "baseline file %r does not exist — pass --no-baseline for "
+            "a full audit, or fix the path" % path)
     with open(path, encoding="utf-8") as f:
         for n, raw in enumerate(f, 1):
             line = raw.strip()
@@ -81,6 +87,69 @@ def load_baseline(path):
     return entries
 
 
+def apply_inline_map(findings, pragmas_by_path):
+    """Inline-pragma pass for whole-program findings, which may land in
+    any scanned file: ``pragmas_by_path`` maps path -> {line: (rules,
+    has_reason)} as collected by the module summaries."""
+    from tools.elastic_lint import Finding
+
+    out = []
+    reported_bad_pragma = set()
+    for f in findings:
+        pragmas = pragmas_by_path.get(f.path, {})
+        suppressed = False
+        for lineno in (f.line, f.line - 1):
+            entry = pragmas.get(lineno)
+            if entry is None or f.rule not in entry[0]:
+                continue
+            if not entry[1]:
+                key = (f.path, lineno)
+                if key not in reported_bad_pragma:
+                    reported_bad_pragma.add(key)
+                    out.append(Finding(
+                        "ELSUP", f.path, lineno, "<pragma>",
+                        "suppression without justification: add "
+                        "'-- <reason>' to the elint pragma",
+                    ))
+                continue
+            suppressed = True
+            break
+        if not suppressed:
+            out.append(f)
+    return out
+
+
 def apply_baseline(findings, baseline):
     return [f for f in findings
             if (f.rule, f.path, f.symbol) not in baseline]
+
+
+def stale_baseline_findings(baseline, raw_findings, scanned_paths,
+                            repo_root):
+    """ELSTALE findings for baseline entries that suppress nothing.
+
+    An entry is stale when its file was part of this scan (or no longer
+    exists at all) and no raw finding matches its (rule, path, symbol)
+    — a zombie suppression that would otherwise linger forever and
+    silently cover a FUTURE regression at the same symbol.  Entries for
+    files outside the scanned set are left alone (partial-tree runs
+    must not flag the rest of the baseline)."""
+    from tools.elastic_lint import Finding
+
+    live = {(f.rule, f.path, f.symbol) for f in raw_findings}
+    out = []
+    for rule, path, symbol in sorted(baseline):
+        if (rule, path, symbol) in live:
+            continue
+        file_gone = not os.path.isfile(os.path.join(repo_root, path))
+        if path not in scanned_paths and not file_gone:
+            continue
+        out.append(Finding(
+            "ELSTALE", path, 0, "%s:%s" % (rule, symbol),
+            "stale baseline entry: %s %s %s matches no current "
+            "finding%s — delete it from baseline.txt (zombie "
+            "suppressions hide future regressions)"
+            % (rule, path, symbol,
+               " (file no longer exists)" if file_gone else ""),
+        ))
+    return out
