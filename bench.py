@@ -221,6 +221,146 @@ def run_bench(batch_size=128, warmup=3, iters=20, fused_steps=0):
     }
 
 
+def run_fused_compare(fused_steps=8, blocks=5, steps_per_block=40,
+                      batch_size=64):
+    """Fused-step driver vs the per-step hot loop at SMALL per-step
+    compute (MNIST MLP) — the regime where host dispatch and the
+    per-step loss sync dominate, i.e. what the worker's fused driver
+    (--fused_steps, worker/fused_driver.py) exists to amortize.
+
+    Methodology (as in BENCH_r04 / bench_ps_wire): INTERLEAVED timed
+    blocks — per-step then fused, alternating — so machine-load drift
+    lands on both legs equally; each leg's block closes with a value
+    fetch (the only real fence on this session's relay).  The per-step
+    leg reproduces the seed loop exactly: one dispatch + one
+    ``float(loss)`` sync per step.  The fused leg runs K steps per
+    dispatch with losses fetched ONCE per block (the report cadence).
+
+    Honest annotation: on CPU the jitted step and the host loop share
+    the same cores, so the measured speedup UNDERSTATES what the TPU
+    path gains (there, dispatch+sync is idle device time the fused
+    window reclaims).  The JSON carries the platform.
+
+    Prints one JSON line; also reports a same-seed loss-equivalence
+    check (fresh trainer pair, identical batch sequence).
+    """
+    _mark("imports_start")
+    import jax
+
+    if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+        jax.config.update(
+            "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except AttributeError:
+        pass
+    import numpy as np
+
+    from elasticdl_tpu.models import mnist
+    from elasticdl_tpu.worker.collective_trainer import CollectiveTrainer
+
+    platform = jax.devices()[0].platform
+    _mark("devices_ok:%s" % platform)
+    assert steps_per_block % fused_steps == 0, "block must fill windows"
+
+    spec = mnist.model_spec(learning_rate=1e-3)
+    xs, ys = mnist.synthetic_data(n=batch_size * 8, seed=0)
+    data = [
+        (xs[i * batch_size:(i + 1) * batch_size],
+         ys[i * batch_size:(i + 1) * batch_size])
+        for i in range(8)
+    ]
+
+    # Same-seed equivalence gate: identical batch sequence through both
+    # paths from identical init — the acceptance criterion's
+    # bit-tolerance check, measured, not assumed.
+    seq = CollectiveTrainer(spec, batch_size=batch_size, rng_seed=0)
+    win = CollectiveTrainer(spec, batch_size=batch_size, rng_seed=0)
+    seq_losses = [float(seq.train_minibatch(*data[i % 8])[0])
+                  for i in range(8)]
+    prepared = [win.prepare_batch(*data[i % 8]) for i in range(8)]
+    win_losses = np.asarray(
+        win.train_window(win.stage_window(prepared))[0]
+    )
+    loss_max_abs_diff = float(
+        np.max(np.abs(np.asarray(seq_losses) - win_losses))
+    )
+    _mark("equivalence_done")
+
+    per_step = CollectiveTrainer(spec, batch_size=batch_size, rng_seed=1)
+    fused = CollectiveTrainer(spec, batch_size=batch_size, rng_seed=1)
+    # warm both programs (compile outside the timed region)
+    float(per_step.train_minibatch(*data[0])[0])
+    warm = [fused.prepare_batch(*data[i % 8]) for i in range(fused_steps)]
+    np.asarray(fused.train_window(fused.stage_window(warm))[0])
+    _mark("warmup_done")
+
+    def per_step_block(k0):
+        t0 = time.perf_counter()
+        for k in range(steps_per_block):
+            loss, _ = per_step.train_minibatch(*data[(k0 + k) % 8])
+            float(loss)          # the seed loop's per-step sync
+        return time.perf_counter() - t0
+
+    def fused_block(k0):
+        t0 = time.perf_counter()
+        losses = None
+        for w in range(steps_per_block // fused_steps):
+            prepared = [
+                fused.prepare_batch(
+                    *data[(k0 + w * fused_steps + i) % 8]
+                )
+                for i in range(fused_steps)
+            ]
+            losses, _ = fused.train_window(fused.stage_window(prepared))
+        np.asarray(losses)       # ONE fetch per block (report cadence)
+        return time.perf_counter() - t0
+
+    pairs = []  # [per_step_ms, fused_ms] per interleaved block
+    for b in range(blocks):
+        k0 = b * steps_per_block
+        pairs.append([
+            round(per_step_block(k0) * 1000.0, 2),
+            round(fused_block(k0) * 1000.0, 2),
+        ])
+    _mark("measured")
+    per_step_sps = (
+        blocks * steps_per_block / (sum(p[0] for p in pairs) / 1000.0)
+    )
+    fused_sps = (
+        blocks * steps_per_block / (sum(p[1] for p in pairs) / 1000.0)
+    )
+    return {
+        "metric": "fused_step_driver_speedup",
+        "value": round(fused_sps / per_step_sps, 3),
+        "unit": "x steps/sec (K=%d fused dispatch + async loss vs "
+                "per-step loop)" % fused_steps,
+        "vs_baseline": None,
+        "detail": {
+            "platform": platform,
+            "per_step_steps_per_sec": round(per_step_sps, 1),
+            "fused_steps_per_sec": round(fused_sps, 1),
+            "fused_steps": fused_steps,
+            "batch_size": batch_size,
+            "loss_max_abs_diff_same_seed": loss_max_abs_diff,
+            "samples": {"pairs": pairs,
+                        "format": "[per_step_ms, fused_ms] per "
+                                  "interleaved block of %d steps"
+                                  % steps_per_block},
+            "note": "CPU legs share cores between the jitted step and "
+                    "the host loop, understating the gain; on TPU the "
+                    "amortized dispatch+sync is reclaimed idle device "
+                    "time" if platform == "cpu" else
+                    "TPU capture: dispatch+sync amortized over K "
+                    "device steps",
+            "device": _device_fingerprint(jax),
+            "env": _env_snapshot(),
+        },
+    }
+
+
 def _device_fingerprint(jax_mod):
     dev = jax_mod.devices()[0]
     return {
@@ -455,6 +595,11 @@ def _run_with_watchdog():
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         run_probe()
+    elif "--compare-fused" in sys.argv:
+        fused = 8
+        if "--fused" in sys.argv:
+            fused = int(sys.argv[sys.argv.index("--fused") + 1])
+        print(json.dumps(run_fused_compare(fused_steps=fused)))
     elif "--inner" in sys.argv:
         batch = 128
         fused = 0

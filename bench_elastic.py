@@ -27,10 +27,13 @@ os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 
 def run_drill(num_workers=2, records=4096, worker_env=None,
-              deadline_secs=180):
+              deadline_secs=180, extra_worker_args=None):
     """One preemption drill.  ``worker_env`` overrides the worker
     process env — the TPU legs use it to aim workers at the real chip
-    and at a persistent compilation cache (see ``main``)."""
+    and at a persistent compilation cache (see ``main``).
+    ``extra_worker_args``: appended worker flags — the fused leg passes
+    ``--fused_steps`` to drill preemption against the windowed hot
+    loop (worker/fused_driver.py)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # master stays on CPU
@@ -54,7 +57,7 @@ def run_drill(num_workers=2, records=4096, worker_env=None,
         "--model_zoo", "mnist", "--data_origin",
         "synthetic_mnist:%d" % records, "--batch_size", "32",
         "--num_minibatches_per_task", "4", "--num_epochs", "2",
-    ]
+    ] + list(extra_worker_args or [])
     worker_manager = WorkerManager(
         ProcessWorkerBackend(worker_args=worker_args,
                              env=worker_env or {}),
@@ -142,6 +145,17 @@ def main():
     legs = detail["platform_legs"]
     legs["cpu"] = run_drill()
     legs["cpu"]["note"] = "2 CPU process workers; control-plane cost"
+    # Same drill against the fused-step hot loop: preemption must land
+    # between windows, flush the in-flight window's progress, and
+    # requeue the remainder — recovery and zero-task-loss must match
+    # the per-step leg (worker/fused_driver.py semantics).
+    legs["cpu_fused"] = run_drill(
+        extra_worker_args=["--fused_steps", "4"]
+    )
+    legs["cpu_fused"]["note"] = (
+        "2 CPU process workers, --fused_steps 4: preemption against "
+        "the windowed hot loop"
+    )
 
     import bench as _bench  # probe + provenance helpers
 

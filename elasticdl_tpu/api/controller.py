@@ -173,13 +173,26 @@ class ElasticCollectiveController:
     def world_size(self):
         return self._rendezvous.world_size
 
-    def step_check(self):
+    def step_check(self, steps=1):
         """One training step's epoch check (driven mode — a managed
         Worker calls this instead of wrapping its loop in
         elastic_run): counts the step for the check_steps cadence and
-        re-forms the world when the cadence says to look."""
-        self._steps_since_check += 1
+        re-forms the world when the cadence says to look.  The fused
+        driver passes its window length as ``steps`` (one check per
+        window, counted as the window's steps BEFORE they run; with
+        windows clamped to ``steps_to_check`` a check fires at most
+        window-1 steps earlier than the per-step loop's — a safe bias
+        for a poll that only re-forms on a real epoch change)."""
+        self._steps_since_check += steps
         return self.init_world_if_needed()
+
+    def steps_to_check(self):
+        """Steps until the next check_steps epoch-check boundary (None
+        when the cadence is time-based) — the fused driver's window
+        clamp."""
+        if self._check_steps is None:
+            return None
+        return max(1, self._check_steps - self._steps_since_check)
 
     def leave_world(self):
         """Temporarily exit the collective world (idle worker, no task

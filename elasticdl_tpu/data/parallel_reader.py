@@ -138,9 +138,16 @@ class ParallelShardReader:
         self.close()
 
 
-def prefetch_batches(batch_iter, depth=2):
+def prefetch_batches(batch_iter, depth=2, prepare=None):
     """Run ``batch_iter`` in a background thread, keeping up to
     ``depth`` batches ready — host feed/decode overlaps device compute.
+
+    ``prepare`` (optional) maps each item on the PRODUCER thread before
+    it is enqueued — the fused training driver passes the trainer's
+    ``prepare_batch`` here so padding/reshape/globalize host work runs
+    in this pipeline stage instead of on the dispatch critical path
+    (docs/training_pipeline.md).  A prepare failure re-raises at the
+    consumer like any producer error.
 
     Exceptions from the producer re-raise at the consumer's next pull,
     so failures surface in the training loop (where the minibatch retry
@@ -166,6 +173,8 @@ def prefetch_batches(batch_iter, depth=2):
     def produce():
         try:
             for batch in batch_iter:
+                if prepare is not None:
+                    batch = prepare(batch)
                 if not _put(batch):
                     return
             _put(_END)

@@ -44,6 +44,21 @@ def add_common_args(parser):
     parser.add_argument("--zero1", type=_str2bool, default=False,
                         help="shard optimizer state over the data axis "
                              "(ZeRO-1) in the collective trainer")
+    parser.add_argument("--fused_steps", type=int, default=1,
+                        help="run up to K optimizer steps per device "
+                             "dispatch in the worker hot loop "
+                             "(fused-step driver; windows clamp to "
+                             "report/checkpoint/log cadence "
+                             "boundaries so elastic semantics are "
+                             "unchanged); 1 = the exact per-step loop")
+    parser.add_argument("--device_prefetch", type=int, default=2,
+                        help="prepared-batch lookahead for the fused "
+                             "driver: batch padding/reshape runs in "
+                             "the prefetch producer and the next "
+                             "window's host->device transfer is "
+                             "staged behind the running step; 0 keeps "
+                             "batch prep on the dispatch critical "
+                             "path")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--profile_dir", default="",
                         help="write a JAX/XLA xplane trace of the worker "

@@ -63,6 +63,22 @@ class Timing:
         finally:
             self.end(name)
 
+    def sync_fraction(self, dispatch_name, sync_name):
+        """Blocked-on-device share of an async hot loop: with the fused
+        driver the step enqueue is timed under ``dispatch_name``
+        ("window_dispatch") and the cadence loss fetch under
+        ``sync_name`` ("loss_sync"), so this is ~0 when overlap works
+        and ->1 when every step stalls on the device.  None until both
+        phases have samples' worth of time."""
+        # Two keyed reads, atomic under the GIL — no snapshot needed
+        # (summary()'s snapshot idiom exists because it iterates ALL
+        # entries while writers may add phases).
+        dispatch = self._totals.get(dispatch_name, 0.0)
+        sync = self._totals.get(sync_name, 0.0)
+        if dispatch + sync <= 0.0:
+            return None
+        return sync / (dispatch + sync)
+
     def summary(self):
         # Snapshot both dicts before deriving: a concurrent observer
         # (serving /statz) must never hit "dict changed size during

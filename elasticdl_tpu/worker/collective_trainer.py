@@ -21,9 +21,55 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 from elasticdl_tpu.utils.timing import Timing
+from elasticdl_tpu.worker.fused_driver import PreparedBatch, StagedWindow
 from elasticdl_tpu.worker.trainer import Trainer
 
 logger = get_logger(__name__)
+
+# prepare_batch plan cache cap: keys are (record count, tree structure)
+# — one full-batch entry plus a handful of tail-batch sizes per task
+# shape, so the cache only grows past this if batch shapes churn.
+_PAD_PLAN_CACHE_MAX = 32
+
+
+class _PadPlan:
+    """Host-side batch-prep plan, derived ONCE per (record count, tree
+    structure) instead of re-deriving ``np.asarray``/shape math inside
+    every ``train_minibatch`` (the per-step hot loop's host tax).
+
+    Holds per-leaf pad widths (None = no pad), per-leaf accum reshape
+    targets (None = no reshape), and the loss-mask weights array.  The
+    weights array is shared read-only across steps — every consumer
+    (device_put, np.stack) copies, never mutates.
+    """
+
+    __slots__ = ("pad_widths", "reshapes", "weights", "local")
+
+    def __init__(self, leaves, n, local, accum, micro):
+        if n > local:
+            raise ValueError(
+                "minibatch has %d records > trainer's global batch %d"
+                % (n, local)
+            )
+        pad = local - n
+        self.local = local
+        self.pad_widths = [
+            [(0, pad)] + [(0, 0)] * (np.asarray(leaf).ndim - 1)
+            if pad else None
+            for leaf in leaves
+        ]
+        if accum > 1:
+            self.reshapes = [
+                (accum, micro) + tuple(np.shape(leaf)[1:])
+                for leaf in leaves
+            ]
+        else:
+            self.reshapes = [None] * len(leaves)
+        weights = np.zeros((local,), dtype=np.float32)
+        weights[:n] = 1.0
+        if accum > 1:
+            weights = weights.reshape(accum, micro)
+        self.weights = weights
 
 
 def _masked_mean(per_example, weights):
@@ -136,6 +182,11 @@ class CollectiveTrainer(Trainer):
         rendezvous epoch changes the device world.
         """
         self._mesh = mesh
+        # Mesh/accum-dependent caches: pad plans bake in the local batch
+        # geometry, fused windows bake in shardings — both die with the
+        # old world.
+        self._pad_plans = {}
+        self._fused_window_cache = {}
         if mesh is not None:
             replicated = NamedSharding(mesh, P())
             self._batch_sharding = NamedSharding(mesh, P(self._data_axis))
@@ -210,6 +261,8 @@ class CollectiveTrainer(Trainer):
     def set_accum_steps(self, accum_steps):
         if accum_steps != self._accum_steps:
             self._accum_steps = accum_steps
+            self._pad_plans = {}
+            self._fused_window_cache = {}
             self._train_step = self._build_train_step()
 
     def _loss_and_grads(self, params, features, labels, weights):
@@ -316,6 +369,64 @@ class CollectiveTrainer(Trainer):
             donate_argnums=(0, 1),
         )
 
+    def _window_batch_sharding(self):
+        """Sharding for window-stacked batch leaves: [K, batch, ...]
+        shards dim 1 (the data axis); with accumulation the stack is
+        [K, accum, micro, ...] and dim 2 is the data axis."""
+        if self._mesh is None:
+            return None
+        if self._accum_steps == 1:
+            return NamedSharding(self._mesh, P(None, self._data_axis))
+        return NamedSharding(
+            self._mesh, P(None, None, self._data_axis)
+        )
+
+    def build_fused_window(self, num_steps):
+        """Compile num_steps optimizer steps over num_steps DISTINCT
+        minibatches (stacked on the leading axis) into ONE XLA program —
+        the production fused-step path (``build_fused_steps`` reuses a
+        single device-resident batch and exists for the bench).
+
+        Returns fn(params, opt_state, features, labels, weights) ->
+        (params, opt_state, losses[num_steps]); losses stay on device
+        until the caller fetches them (fused_driver.LossRing).
+
+        The scan is fully UNROLLED: a rolled scan double-buffers the
+        params/opt-state carry every iteration (measured ~4x slower
+        than sequential dispatch on CPU XLA), while the unrolled body
+        is one straight-line program XLA fuses across steps (~2.4x
+        faster than the per-step loop on the same rig).  Compile time
+        scales with num_steps — keep --fused_steps modest (4-16); each
+        distinct window length compiles once and is cached.
+        """
+        raw = self._raw_step
+
+        def window(params, opt_state, features, labels, weights):
+            def body(carry, batch):
+                params, opt_state = carry
+                f, l, w = batch
+                params, opt_state, loss = raw(params, opt_state, f, l, w)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (features, labels, weights),
+                unroll=True,
+            )
+            return params, opt_state, losses
+
+        if self._mesh is None:
+            return jax.jit(window, donate_argnums=(0, 1))
+        rep = self._replicated
+        opt_sharding = self._opt_out_shardings() if self._zero1 else rep
+        batch_in = self._window_batch_sharding()
+        return jax.jit(
+            window,
+            in_shardings=(rep, opt_sharding, batch_in, batch_in,
+                          batch_in),
+            out_shardings=(rep, opt_sharding, rep),
+            donate_argnums=(0, 1),
+        )
+
     def _build_eval_step(self):
         apply_fn = self._spec.apply_fn
 
@@ -336,7 +447,21 @@ class CollectiveTrainer(Trainer):
         (features, labels), weights = _pad_batch((features, labels), total)
         return features, labels, weights
 
-    def train_minibatch(self, features, labels):
+    def _accum_sharding(self):
+        """Per-step batch sharding: [batch, ...] over data, or
+        [accum, micro, ...] with the microbatch axis over data."""
+        if self._mesh is None:
+            return None
+        if self._accum_steps == 1:
+            return self._batch_sharding
+        return NamedSharding(self._mesh, P(None, self._data_axis))
+
+    def prepare_batch(self, features, labels, count=None):
+        """Host-side batch prep (pad, accum reshape, multi-controller
+        globalize) via a cached per-(count, structure) plan — the
+        producer-stage half of the fused driver; ``train_minibatch``
+        routes through it too, so the per-step path stops re-deriving
+        shapes every step."""
         if self._example_features is None:
             # Shape/dtype skeleton of one raw minibatch — fixes the
             # serving signature of the train-end servable export.
@@ -344,47 +469,153 @@ class CollectiveTrainer(Trainer):
                 lambda a: np.zeros(np.shape(a), np.asarray(a).dtype),
                 features,
             )
-        with self.timing.timeit("batch_process"):
-            # Each process pads ITS local minibatch to its share of the
-            # global batch; _globalize assembles the global array in
-            # the multi-controller case (no-op single-process).
-            procs = self.process_count
-            if self._accum_steps == 1:
-                local = self._batch_size * (
-                    self.global_device_count // procs
-                )
-                features, labels, weights = self._padded(
-                    features, labels, local
-                )
-                features = self._globalize(features, self._batch_sharding)
-                labels = self._globalize(labels, self._batch_sharding)
-                weights = self._globalize(weights, self._batch_sharding)
-            else:
+        with self.timing.timeit("batch_prep"):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                (features, labels)
+            )
+            n = int(np.shape(leaves[0])[0])
+            # Trailing dims are part of the key: with accumulation the
+            # plan bakes reshape targets, and a pipeline with variable
+            # trailing shapes (e.g. sequence length) must not hit a
+            # stale plan's targets.
+            key = (n, treedef,
+                   tuple(np.shape(leaf)[1:] for leaf in leaves))
+            plan = self._pad_plans.get(key)
+            if plan is None:
+                procs = self.process_count
                 micro = self._batch_size * (
                     self.global_device_count // procs
                 )
                 local = micro * self._accum_steps
-                features, labels, weights = self._padded(
-                    features, labels, local
+                plan = _PadPlan(
+                    leaves, n, local, self._accum_steps, micro
                 )
-                reshape = lambda a: np.asarray(a).reshape(
-                    (self._accum_steps, micro) + np.asarray(a).shape[1:]
-                )
-                features = jax.tree_util.tree_map(reshape, features)
-                labels = jax.tree_util.tree_map(reshape, labels)
-                weights = weights.reshape(self._accum_steps, micro)
-                accum_sharding = NamedSharding(
-                    self._mesh, P(None, self._data_axis)
-                ) if self._mesh is not None else None
-                features = self._globalize(features, accum_sharding)
-                labels = self._globalize(labels, accum_sharding)
-                weights = self._globalize(weights, accum_sharding)
+                if len(self._pad_plans) >= _PAD_PLAN_CACHE_MAX:
+                    self._pad_plans.clear()
+                self._pad_plans[key] = plan
+            out = []
+            for leaf, pad_width, reshape in zip(
+                leaves, plan.pad_widths, plan.reshapes
+            ):
+                a = np.asarray(leaf)
+                if pad_width is not None:
+                    a = np.pad(a, pad_width)
+                if reshape is not None:
+                    a = a.reshape(reshape)
+                out.append(a)
+            features, labels = jax.tree_util.tree_unflatten(treedef, out)
+            weights = plan.weights
+            if self.process_count > 1:
+                sharding = self._accum_sharding()
+                features = self._globalize(features, sharding)
+                labels = self._globalize(labels, sharding)
+                weights = self._globalize(weights, sharding)
+        return PreparedBatch(
+            features, labels, weights, n if count is None else count
+        )
+
+    def train_minibatch(self, features, labels):
+        """One step; returns (loss, version) where ``loss`` is a LAZY
+        device scalar — no host sync here.  Callers that need a float
+        (cadence logging, benches) pull it explicitly via
+        ``float(loss)``; that fetch is the fence."""
+        prepared = self.prepare_batch(features, labels)
+        with self.timing.timeit("step_dispatch"):
             self._params, self._opt_state, loss = self._train_step(
-                self._params, self._opt_state, features, labels, weights
+                self._params, self._opt_state,
+                prepared.features, prepared.labels, prepared.weights,
             )
         self._version += 1
         self._maybe_report_and_checkpoint()
-        return float(loss), self._version
+        return loss, self._version
+
+    # -- fused window API (fused_driver.FusedStepDriver) --------------------
+
+    @property
+    def max_window(self):
+        """None = unbounded fused windows.  Multi-controller batches
+        are committed global arrays (per-process assembly) — stacking
+        them host-side is impossible, so the driver is capped to
+        window 1 there."""
+        return 1 if self.process_count > 1 else None
+
+    def steps_to_boundary(self):
+        """Steps until the next version-report or checkpoint cadence
+        boundary — the fused driver clamps windows to it so those
+        events land on exactly the per-step loop's step numbers."""
+        dists = []
+        if self._mc is not None and self._report_version_steps:
+            dists.append(
+                self._report_version_steps
+                - self._version % self._report_version_steps
+            )
+        if self._checkpoint_saver is not None and self._checkpoint_steps:
+            dists.append(
+                self._checkpoint_steps
+                - self._version % self._checkpoint_steps
+            )
+        return min(dists) if dists else None
+
+    def stage_window(self, prepared, to_device=True):
+        """Stack K prepared batches on a leading axis and (optionally)
+        start their host→device transfer NOW — ``device_put`` is async,
+        so staging window N+1 while window N executes is the device
+        double-buffer."""
+        k = len(prepared)
+        if k > 1 and self.process_count > 1:
+            raise ValueError(
+                "fused windows are single-controller only (max_window)"
+            )
+        if k == 1:
+            batch = prepared[0]
+            features, labels = batch.features, batch.labels
+            weights = batch.weights
+            sharding = self._accum_sharding()
+        else:
+            stack = lambda *leaves: np.stack(leaves)
+            features = jax.tree_util.tree_map(
+                stack, *[b.features for b in prepared]
+            )
+            labels = jax.tree_util.tree_map(
+                stack, *[b.labels for b in prepared]
+            )
+            weights = np.stack([b.weights for b in prepared])
+            sharding = self._window_batch_sharding()
+        if to_device and self.process_count == 1:
+            if sharding is not None:
+                put = lambda tree: jax.device_put(tree, sharding)
+            else:
+                put = jax.device_put
+            features, labels, weights = (
+                put(features), put(labels), put(weights)
+            )
+        return StagedWindow(k, features, labels, weights)
+
+    def train_window(self, staged):
+        """Dispatch one staged window (1 XLA call for its K steps);
+        returns (device-resident losses, version-after-window).  The
+        caller is responsible for clamping K to ``steps_to_boundary``
+        (fused_driver does) — report/checkpoint cadence checks run once
+        at the window boundary."""
+        if staged.size == 1:
+            with self.timing.timeit("step_dispatch"):
+                self._params, self._opt_state, losses = self._train_step(
+                    self._params, self._opt_state,
+                    staged.features, staged.labels, staged.weights,
+                )
+        else:
+            fn = self._fused_window_cache.get(staged.size)
+            if fn is None:
+                fn = self.build_fused_window(staged.size)
+                self._fused_window_cache[staged.size] = fn
+            with self.timing.timeit("step_dispatch"):
+                self._params, self._opt_state, losses = fn(
+                    self._params, self._opt_state,
+                    staged.features, staged.labels, staged.weights,
+                )
+        self._version += staged.size
+        self._maybe_report_and_checkpoint()
+        return losses, self._version
 
     def _maybe_report_and_checkpoint(self):
         if (

@@ -36,6 +36,13 @@ class DataShardService:
         self._lock = threading.Lock()
         self._pending = deque()   # tasks whose records are being consumed
         self._record_count = 0
+        # Records counted locally but not yet sent to the master's
+        # report_batch_done RPC — the fused driver defers per-batch
+        # counts and flushes ONE RPC per window (flush is mandatory and
+        # structural at task boundaries: report_task_done/failed and
+        # shard auto-completion all flush first, so no progress count
+        # is silently lost or double-sent).
+        self._deferred_records = 0
         self._stopped = threading.Event()
         self._stop_check = stop_check  # e.g. graceful-preemption flag
         self.exec_counters = {"batch_count": 0, "record_count": 0}
@@ -71,12 +78,21 @@ class DataShardService:
                     self._pending.append(task)
             return task
 
-    def report_batch_done(self, batch_size=None):
-        """Count consumed records; auto-complete tasks as shards drain."""
+    def report_batch_done(self, batch_size=None, defer=False):
+        """Count consumed records; auto-complete tasks as shards drain.
+
+        ``defer=True`` buffers the master RPC (local accounting still
+        happens immediately): the fused driver reports each batch of a
+        window deferred and sends ONE coalesced ``report_batch_done``
+        via ``flush_batch_done`` at the window boundary.  A shard
+        draining to completion is a task boundary — it forces the flush
+        regardless, so the master's progress counts are current
+        whenever its task accounting changes.
+        """
         count = batch_size or self._batch_size
-        self._mc.report_batch_done(count)
         done = []
         with self._lock:
+            self._deferred_records += count
             self._record_count += count
             self.exec_counters["batch_count"] += 1
             self.exec_counters["record_count"] += count
@@ -84,18 +100,34 @@ class DataShardService:
                 task = self._pending.popleft()
                 self._record_count -= task.size
                 done.append(task.id)
+            flush = self._deferred_records if (not defer or done) else 0
+            if flush:
+                self._deferred_records = 0
             # Snapshot inside, RPC outside: a slow/retrying master must
             # stall only this caller, not every thread entering
             # fetch_task/report_batch_done for the RPC's duration.
             counters = dict(self.exec_counters) if done else None
+        if flush:
+            self._mc.report_batch_done(flush)
         for task_id in done:
             self._mc.report_task_result(task_id, exec_counters=counters)
+
+    def flush_batch_done(self):
+        """Send any deferred record counts in one RPC (no-op when
+        nothing is buffered).  Mandatory at window boundaries, on
+        preemption, and at task boundaries — report_task_done/failed
+        call it structurally."""
+        with self._lock:
+            flush, self._deferred_records = self._deferred_records, 0
+        if flush:
+            self._mc.report_batch_done(flush)
 
     def report_task_failed(self, task, err_message, requeue=False):
         """``requeue``: hand the task back WITHOUT consuming one of its
         retries (graceful preemption — the task isn't at fault; on a
         preemptible pool the same task could otherwise burn its whole
         retry budget on evictions and permanently fail)."""
+        self.flush_batch_done()  # progress counts must precede the verdict
         with self._lock:
             try:
                 was_head = self._pending and self._pending[0] is task
@@ -114,6 +146,7 @@ class DataShardService:
                                     requeue=requeue)
 
     def report_task_done(self, task):
+        self.flush_batch_done()  # progress counts must precede the verdict
         with self._lock:
             try:
                 self._pending.remove(task)

@@ -94,6 +94,11 @@ def build_worker(args):
             mc, reader, spec, trainer,
             batch_size=args.batch_size,
             log_loss_steps=args.log_loss_steps,
+            # Same driver API as the collective path; the PS trainer's
+            # max_window=1 keeps it on the per-step loop (its overlap
+            # lives in the async push pipeline + embedding prefetch).
+            fused_steps=args.fused_steps,
+            device_prefetch=args.device_prefetch,
         )
     mesh = None
     if args.distribution_strategy == "collective":
@@ -156,6 +161,8 @@ def build_worker(args):
         log_loss_steps=args.log_loss_steps,
         join_rendezvous=args.distribution_strategy == "collective",
         elastic_controller=elastic,
+        fused_steps=args.fused_steps,
+        device_prefetch=args.device_prefetch,
     )
     return worker
 

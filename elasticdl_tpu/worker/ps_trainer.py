@@ -47,6 +47,7 @@ from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 from elasticdl_tpu.utils.timing import Timing
 from elasticdl_tpu.worker.collective_trainer import _pad_batch
+from elasticdl_tpu.worker.fused_driver import PreparedBatch, StagedWindow
 from elasticdl_tpu.worker.trainer import Trainer
 
 logger = get_logger(__name__)
@@ -466,7 +467,53 @@ class ParameterServerTrainer(Trainer):
         # DeepFM tests masked it because embedding pulls aren't
         # version-gated).  _version advances only in _pull_dense.
         self._steps += 1
-        return float(loss), version
+        # LAZY loss: the push path already materialized the gradients
+        # (inline or on the push thread), so syncing on the loss here
+        # bought nothing but a host stall.  Callers that need a float
+        # pull it explicitly at cadence (worker loss log, benches).
+        return loss, version
+
+    # -- fused window API (fused_driver.FusedStepDriver) --------------------
+
+    @property
+    def max_window(self):
+        """The PS hot path's overlap lives in the async push pipeline
+        and the embedding prefetcher, and every step may need a fresh
+        pull at the get_model_steps cadence — so the fused driver is a
+        window=1 passthrough here (same driver API, per-step loop)."""
+        return 1
+
+    def steps_to_boundary(self):
+        return None
+
+    def prepare_batch(self, features, labels, count=None):
+        """Passthrough: padding happens inside train_minibatch, AFTER
+        the embedding-id extraction that must see the raw feature dict
+        (IDS_KEY plumbing)."""
+        n = jax.tree_util.tree_leaves(features)[0].shape[0]
+        return PreparedBatch(
+            features, labels, None, n if count is None else count
+        )
+
+    def stage_window(self, prepared, to_device=True):
+        del to_device  # host-side trainer: nothing to stage
+        return StagedWindow(
+            len(prepared),
+            [b.features for b in prepared],
+            [b.labels for b in prepared],
+            None,
+        )
+
+    def train_window(self, staged):
+        """Window=1 passthrough of the fused-driver API: steps run
+        sequentially (each may pull/push at its own cadence); losses
+        come back as lazy device scalars."""
+        losses = []
+        version = self._version
+        for features, labels in zip(staged.features, staged.labels):
+            loss, version = self.train_minibatch(features, labels)
+            losses.append(loss)
+        return losses[0] if len(losses) == 1 else losses, version
 
     def evaluate_minibatch(self, features, labels):
         # Flush pending pushes so evaluation reads a PS state that

@@ -6,7 +6,15 @@ import abc
 class Trainer(abc.ABC):
     @abc.abstractmethod
     def train_minibatch(self, features, labels):
-        """Run one training step; returns (loss: float, version: int)."""
+        """Run one training step; returns (loss, version: int).
+
+        ``loss`` is a LAZY device scalar — no host sync happens here.
+        Callers that need a float (cadence logging, benches) convert
+        explicitly with ``float(loss)``; that fetch is the device
+        fence.  Trainers may additionally implement the fused-window
+        API (``prepare_batch`` / ``stage_window`` / ``train_window`` /
+        ``max_window`` / ``steps_to_boundary``) to opt into multi-step
+        dispatch (worker/fused_driver.py)."""
 
     @abc.abstractmethod
     def evaluate_minibatch(self, features, labels):

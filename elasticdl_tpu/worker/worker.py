@@ -41,13 +41,23 @@ class Worker:
         log_loss_steps=100,
         join_rendezvous=False,
         elastic_controller=None,
+        fused_steps=1,
+        device_prefetch=2,
     ):
         """``elastic_controller`` (ElasticCollectiveController): drives
         the multi-controller collective world from inside the managed
         task loop — epoch checks before minibatches (step-count
         cadence, SPMD-aligned across workers) and await-new-epoch on a
         failed collective.  None = single-process trainer (the
-        historical managed path)."""
+        historical managed path).
+
+        ``fused_steps``: run up to K optimizer steps per device
+        dispatch through the fused-step driver (worker/fused_driver.py)
+        when the trainer supports windows; 1 (default) is exactly the
+        classic per-step loop.  ``device_prefetch``: prepared-batch
+        lookahead depth for the producer stage; > 0 also stages the
+        next window's device transfer behind the running step, 0 keeps
+        batch prep on the dispatch path."""
         self._mc = master_client
         self._spec = spec
         self._trainer = trainer
@@ -56,6 +66,8 @@ class Worker:
         self._log_loss_steps = log_loss_steps
         self._join_rendezvous = join_rendezvous
         self._elastic = elastic_controller
+        self._fused_steps = max(1, int(fused_steps))
+        self._device_prefetch = max(0, int(device_prefetch))
         self._shard_service = DataShardService(
             master_client, batch_size,
             # The WAIT poll must abort on graceful preemption — an idle
@@ -96,11 +108,28 @@ class Worker:
                 loss, version = self._trainer.train_minibatch(
                     features, labels
                 )
+                if (
+                    self._elastic is not None
+                    and self._elastic.world_size > 1
+                ):
+                    # Multi-controller worlds keep the per-step sync:
+                    # an in-band collective failure must surface ON the
+                    # failing minibatch, inside THIS retry scope, so
+                    # the await-new-epoch recovery below retries the
+                    # right batch before its records are reported done.
+                    # (Cross-process collectives serialize on the wire
+                    # anyway — the lazy-loss win lives on the
+                    # single-controller hot paths.)
+                    float(loss)
                 self._steps += 1
                 if self._steps % self._log_loss_steps == 0:
+                    # train_minibatch returns a LAZY device loss; this
+                    # float() is the only per-cadence host sync.
+                    with self.timing.timeit("loss_sync"):
+                        loss_value = float(loss)
                     logger.info(
                         "step %d loss %.6f (version %d)",
-                        self._steps, loss, version,
+                        self._steps, loss_value, version,
                     )
                 return loss
             except Exception as e:  # noqa: BLE001 — retry then surface
@@ -134,9 +163,68 @@ class Worker:
             "minibatch failed after %d retries" % self._max_minibatch_retries
         ) from err
 
+    def _windowed_driver(self):
+        """The fused-step driver when it would actually fuse (> 1 step
+        per dispatch); None selects the classic per-step loop — which
+        stays the path for ``--fused_steps 1``, the PS trainer
+        (max_window 1) and multi-controller collectives."""
+        if self._fused_steps <= 1 or not hasattr(
+            self._trainer, "train_window"
+        ):
+            return None
+        from elasticdl_tpu.worker.fused_driver import FusedStepDriver
+
+        driver = FusedStepDriver(
+            self._trainer, self._shard_service, self.timing,
+            fused_steps=self._fused_steps,
+            device_prefetch=self._device_prefetch,
+            log_loss_steps=self._log_loss_steps,
+            elastic=self._elastic,
+            stop_check=lambda: self._preempt_requested,
+            callbacks=self._spec.callbacks,
+            # Prep placement: producer thread when no elastic
+            # controller (overlap), inside the driver AFTER the epoch
+            # check otherwise — a world re-form can change batch
+            # geometry (accum resize), and batches prepared ahead
+            # under the old world must never be dispatched after it.
+            prepare=(
+                None if self._producer_prepares()
+                else lambda item: self._trainer.prepare_batch(*item)
+            ),
+        )
+        return driver if driver.effective_window > 1 else None
+
+    def _producer_prepares(self):
+        return self._device_prefetch > 0 and self._elastic is None
+
+    def _run_task_windowed(self, task, driver):
+        """Fused hot loop: batch prep in the prefetch producer, K steps
+        per dispatch, device double-buffer, coalesced progress RPCs,
+        loss fetched at cadence (docs/training_pipeline.md)."""
+        from elasticdl_tpu.data.parallel_reader import prefetch_batches
+
+        prepare = None
+        if self._producer_prepares():
+            prepare = lambda item: self._trainer.prepare_batch(*item)
+        # else: the driver preps each window itself, after its elastic
+        # epoch check (or at dispatch with --device_prefetch 0) — the
+        # stream hands raw (features, labels, count) items through.
+        batches = prefetch_batches(
+            self._data_service.batch_stream(task, self._batch_size),
+            depth=max(2, self._device_prefetch),
+            prepare=prepare,
+        )
+        ran, preempted = driver.run_task(
+            batches, steps_done=self._steps
+        )
+        self._steps += ran
+        if preempted or self._preempt_requested:
+            raise PreemptedExit()
+
     def _train_task(self, task):
         from elasticdl_tpu.data.parallel_reader import prefetch_batches
 
+        driver = self._windowed_driver()
         # PS trainers can start the NEXT batch's embedding pulls while
         # the current device step runs; the one-batch lookahead below
         # feeds that prefetcher (it composes with prefetch_batches,
@@ -146,6 +234,9 @@ class Worker:
         )
         with self.timing.timeit("task_process"):
             try:
+                if driver is not None:
+                    self._run_task_windowed(task, driver)
+                    return
                 # Prefetch so host-side read/decode/feed overlaps the
                 # device step (the input-pipeline half of keeping the
                 # MXU busy); producer errors re-raise here where the
@@ -162,7 +253,17 @@ class Worker:
                     pending = next(batches, None)
                     if pending is not None and prefetch_embeddings:
                         prefetch_embeddings(pending[0])
-                    self._process_minibatch(features, labels)
+                    loss = self._process_minibatch(features, labels)
+                    if pending is None:
+                        # Task-final fence: the last report below can
+                        # auto-complete the task at the master, so the
+                        # last (lazy) step must verifiably finish
+                        # first — the completion guarantee the loop
+                        # used to get for free from per-step
+                        # float(loss); steps chain through params, so
+                        # fencing the last one proves them all.
+                        with self.timing.timeit("loss_sync"):
+                            float(loss)
                     self._shard_service.report_batch_done(count)
                     if self._preempt_requested:
                         raise PreemptedExit()
