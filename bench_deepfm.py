@@ -208,6 +208,7 @@ def run_worker(ports, batch_size=512, vocab_size=100_000, num_fields=10,
         "timing": {
             name: round(s["total_s"], 3)
             for name, s in trainer.timing.summary().items()
+            if "total_s" in s  # skip counter sections (e.g. zero1)
         },
     }))
 

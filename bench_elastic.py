@@ -27,19 +27,30 @@ os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 
 def run_drill(num_workers=2, records=4096, worker_env=None,
-              deadline_secs=180, extra_worker_args=None):
+              deadline_secs=180, extra_worker_args=None,
+              with_rendezvous=False, wait_complete=False):
     """One preemption drill.  ``worker_env`` overrides the worker
     process env — the TPU legs use it to aim workers at the real chip
     and at a persistent compilation cache (see ``main``).
     ``extra_worker_args``: appended worker flags — the fused leg passes
     ``--fused_steps`` to drill preemption against the windowed hot
-    loop (worker/fused_driver.py)."""
+    loop (worker/fused_driver.py).
+
+    ``with_rendezvous``: attach a RendezvousServer so collective-mode
+    workers get membership epochs (no coordinator factory — each
+    worker keeps a process-local device mesh, which is what this
+    container's jax supports, but every join/leave commits a real
+    epoch, so the preemption exercises snapshot -> rebuild ->
+    re-partition on the survivors).  ``wait_complete``: after recovery
+    is measured, wait for the JOB to finish and account every record —
+    the zero-lost/zero-double-count gate of the zero1 churn leg."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # master stays on CPU
 
     from elasticdl_tpu.data.factory import create_data_reader
     from elasticdl_tpu.master.master import Master
+    from elasticdl_tpu.master.rendezvous import RendezvousServer
     from elasticdl_tpu.master.task_manager import TaskManager
     from elasticdl_tpu.master.worker_manager import (
         ProcessWorkerBackend,
@@ -47,23 +58,31 @@ def run_drill(num_workers=2, records=4096, worker_env=None,
     )
     from elasticdl_tpu.proto import elastic_pb2 as pb
 
+    records_per_task = 128
+    num_epochs = 2
     reader = create_data_reader("synthetic_mnist:%d" % records,
-                                records_per_shard=128)
+                                records_per_shard=records_per_task)
     task_manager = TaskManager(
-        training_shards=reader.create_shards(), records_per_task=128,
-        num_epochs=2,
+        training_shards=reader.create_shards(),
+        records_per_task=records_per_task,
+        num_epochs=num_epochs,
     )
     worker_args = [
         "--model_zoo", "mnist", "--data_origin",
         "synthetic_mnist:%d" % records, "--batch_size", "32",
-        "--num_minibatches_per_task", "4", "--num_epochs", "2",
+        "--num_minibatches_per_task", "4", "--num_epochs",
+        str(num_epochs),
     ] + list(extra_worker_args or [])
     worker_manager = WorkerManager(
         ProcessWorkerBackend(worker_args=worker_args,
                              env=worker_env or {}),
         num_workers=num_workers,
     )
-    master = Master(task_manager, worker_manager=worker_manager)
+    rendezvous = (
+        RendezvousServer(grace_secs=1.0) if with_rendezvous else None
+    )
+    master = Master(task_manager, worker_manager=worker_manager,
+                    rendezvous_server=rendezvous)
 
     events = {}
     launch_times = []
@@ -102,10 +121,34 @@ def run_drill(num_workers=2, records=4096, worker_env=None,
             break
         time.sleep(0.05)
 
+    expected_tasks = -(-records // records_per_task) * num_epochs
+    records_ok = None
+    if wait_complete:
+        # Run the job to the end and account every record: the
+        # preempted worker's in-flight task must be requeued (never
+        # lost) and its completed batches never double-reported, so
+        # exactly the expected task count completes — no more (a
+        # double count would finish a task twice), no less.
+        deadline = time.time() + deadline_secs
+        while time.time() < deadline:
+            counts = task_manager.counts()
+            done = (counts["completed"][pb.TRAINING]
+                    + counts["failed"][pb.TRAINING])
+            if counts["todo"] == 0 and counts["doing"] == 0 and (
+                done >= expected_tasks
+            ):
+                break
+            time.sleep(0.2)
+        counts = task_manager.counts()
+        records_ok = (
+            counts["completed"][pb.TRAINING] == expected_tasks
+            and counts["failed"][pb.TRAINING] == 0
+        )
+
     master.stop()
     runner.join(timeout=30)
     counts = task_manager.counts()
-    return {
+    out = {
         "recovery_secs": round(recovery_secs, 3) if recovery_secs
         else None,
         "relaunch_secs": round(relaunch_secs, 3) if relaunch_secs
@@ -113,6 +156,10 @@ def run_drill(num_workers=2, records=4096, worker_env=None,
         "tasks_failed_permanently": counts["failed"][pb.TRAINING],
         "tasks_completed": counts["completed"][pb.TRAINING],
     }
+    if wait_complete:
+        out["tasks_expected"] = expected_tasks
+        out["all_records_accounted"] = records_ok
+    return out
 
 
 def main():
@@ -155,6 +202,33 @@ def main():
     legs["cpu_fused"]["note"] = (
         "2 CPU process workers, --fused_steps 4: preemption against "
         "the windowed hot loop"
+    )
+    # ZeRO-1 churn leg: collective workers (each on a process-local
+    # 4-device virtual mesh — this container's jax has no multi-proc
+    # coordination service, so epochs re-form per-process worlds) with
+    # sharded optimizer state and fused windows.  The kill lands a
+    # real rendezvous epoch on the survivor: snapshot gathers its live
+    # zero1 shards, rebuild re-shards them, and the job then runs to
+    # completion with every record accounted exactly once.  (The
+    # trajectory-bitwise-through-resize assertion lives in
+    # bench_zero.py's in-process churn, where both runs share one
+    # param state.)
+    legs["cpu_zero1"] = run_drill(
+        extra_worker_args=[
+            "--distribution_strategy", "collective",
+            "--zero1", "true", "--fused_steps", "4",
+        ],
+        worker_env={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
+        with_rendezvous=True,
+        wait_complete=True,
+    )
+    legs["cpu_zero1"]["note"] = (
+        "2 CPU collective workers, --zero1 --fused_steps 4, "
+        "4-device process-local meshes: preemption re-forms the "
+        "world with live sharded optimizer state; job runs to "
+        "completion with exact record accounting"
     )
 
     import bench as _bench  # probe + provenance helpers

@@ -42,8 +42,18 @@ def add_common_args(parser):
     parser.add_argument("--log_loss_steps", type=int, default=100)
     parser.add_argument("--use_bf16", type=_str2bool, default=False)
     parser.add_argument("--zero1", type=_str2bool, default=False,
-                        help="shard optimizer state over the data axis "
-                             "(ZeRO-1) in the collective trainer")
+                        help="ZeRO-1 weight-update sharding in the "
+                             "collective trainer: every optimizer-state "
+                             "leaf is flattened, padded, and sharded "
+                             "over the data axis (per-device optimizer "
+                             "memory ~1/N, reported at startup), the "
+                             "update runs shard-locally between a "
+                             "reduce-scatter/all-gather pair, and "
+                             "world re-forms re-partition live shards "
+                             "device-to-device with Adam moments "
+                             "preserved bit-exactly; loss trajectory "
+                             "is bit-identical to the replicated "
+                             "default (false = exact old path)")
     parser.add_argument("--fused_steps", type=int, default=1,
                         help="run up to K optimizer steps per device "
                              "dispatch in the worker hot loop "

@@ -85,7 +85,7 @@ class Timing:
         # iteration" because the executor thread added a phase.
         totals = dict(list(self._totals.items()))
         counts = dict(list(self._counts.items()))
-        return {
+        out = {
             name: {
                 "total_s": totals[name],
                 "count": counts.get(name, 0),
@@ -93,10 +93,25 @@ class Timing:
             }
             for name in totals
         }
+        # ZeRO-1 section: the sharded-update byte counters
+        # (reduce-scatter/all-gather payloads per step, elastic reshard
+        # traffic) grouped so bench/statz consumers see them as one
+        # block.  Present only when a zero1 trainer bumped them, so
+        # phase-only consumers (which iterate {total_s,...} entries)
+        # are unaffected elsewhere.
+        zero1 = {
+            name: count for name, count in list(self._events.items())
+            if name.startswith("zero1_")
+        }
+        if zero1:
+            out["zero1"] = zero1
+        return out
 
     def report(self):
         if self._logger is not None:
             for name, s in sorted(self.summary().items()):
+                if "total_s" not in s:
+                    continue  # counter section (zero1), logged below
                 self._logger.info(
                     "timing[%s]: total=%.3fs count=%d mean=%.4fs",
                     name,

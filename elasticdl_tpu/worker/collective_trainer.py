@@ -9,6 +9,13 @@ elasticai_api/pytorch/optimizer.py:136-169) becomes a ``lax.scan`` gradient
 accumulation over microbatches, re-jitted when the accumulation count
 changes with the world size.  Rebuilding for a new mesh = re-sharding params
 and re-jitting — the compile cache keyed by (mesh shape, accum steps).
+
+``--zero1`` swaps the weight update for ZeRO-1 cross-replica sharding
+(worker/zero.py, docs/training_pipeline.md): optimizer state lives as
+flat padded 1-D shards over the data axis (per-device optimizer memory
+~1/N), the update runs shard-locally between an explicit
+reduce-scatter/all-gather pair, and a world re-form re-partitions the
+live shards device-to-device with Adam moments preserved bit-exactly.
 """
 
 
@@ -23,6 +30,7 @@ from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 from elasticdl_tpu.utils.timing import Timing
 from elasticdl_tpu.worker.fused_driver import PreparedBatch, StagedWindow
 from elasticdl_tpu.worker.trainer import Trainer
+from elasticdl_tpu.worker.zero import ZeroPartitioner
 
 logger = get_logger(__name__)
 
@@ -126,11 +134,16 @@ class CollectiveTrainer(Trainer):
         self._use_bf16_compute = use_bf16_compute
         # ZeRO-1: shard optimizer state over the data axis instead of
         # replicating it — Adam moments cost 2x params, so an 8-way dp
-        # mesh drops per-device optimizer memory ~8x.  XLA places the
-        # update math on each leaf's shard owner and re-gathers the
-        # params (GSPMD annotation-driven; no reference counterpart —
-        # deliberate beyond-reference design, SURVEY §2.12).
+        # mesh drops per-device optimizer memory ~8x (no reference
+        # counterpart — deliberate beyond-reference design, SURVEY
+        # §2.12).  Every optimizer leaf is flattened to 1-D, padded to
+        # a multiple of the shard count, and sharded on dim 0
+        # (worker/zero.py), so coverage is total regardless of leaf
+        # shape; the train step updates only the local shard and
+        # all-gathers fresh params (docs/training_pipeline.md).
         self._zero1 = zero1
+        self._zero = None          # active partitioner (mesh worlds)
+        self._opt_is_flat = False  # opt-state representation marker
         self.timing = Timing(logger=logger)
         self._version = 0
         self._ckpt_executor = None
@@ -152,12 +165,16 @@ class CollectiveTrainer(Trainer):
         master-coordinated world: the re-init clears XLA backends, which
         invalidates every device array of the old epoch.  Replicated
         leaves always survive (each process holds a full copy).  A
-        ZeRO-1-sharded optimizer leaf is only partially addressable —
-        when a peer died, its shard died with it, so the leaf cannot be
-        reassembled: optimizer state is re-initialized from the (still
-        complete) params, and training resumes with fresh moments (the
-        same information loss the reference accepts when a Horovod
-        restart reloads the last checkpoint without optimizer slots)."""
+        ZeRO-1 state is gathered through its unpadding view as a jitted
+        on-device all-gather FIRST (``ZeroPartitioner.gather_to_host``),
+        so even in a multi-controller world every process holds the full
+        original-shape value before the host transfer — ``to_numpy`` on
+        a raw sharded leaf would hit non-addressable shards.  Only when
+        that gather itself fails (a peer died mid-epoch and took its
+        shards with it) is optimizer state re-initialized from the
+        (still complete) params — the same information loss the
+        reference accepts when a Horovod restart reloads the last
+        checkpoint without optimizer slots."""
         try:
             self._params = to_numpy(self._params)
         except Exception as e:
@@ -166,7 +183,12 @@ class CollectiveTrainer(Trainer):
                 "survive a world change without a checkpoint restore"
             ) from e
         try:
-            self._opt_state = to_numpy(self._opt_state)
+            if self._opt_is_flat and self._zero is not None:
+                self._opt_state = self._zero.gather_to_host(
+                    self._opt_state
+                )
+            else:
+                self._opt_state = to_numpy(self._opt_state)
         except Exception:  # noqa: BLE001 — lost ZeRO-1 shards
             logger.warning(
                 "optimizer state not locally addressable (ZeRO-1 "
@@ -174,13 +196,23 @@ class CollectiveTrainer(Trainer):
                 "optimizer moments from params"
             )
             self._opt_state = self._spec.optimizer.init(self._params)
+        self._opt_is_flat = False
 
     def rebuild(self, mesh):
         """(Re)shard state and (re)compile steps for a (new) mesh.
 
         This is the elastic-resize path: called at init and whenever the
-        rendezvous epoch changes the device world.
+        rendezvous epoch changes the device world.  State placement is
+        device-to-device whenever the arrays are live on a surviving
+        backend (``jax.device_put`` re-shards committed arrays across
+        mesh shapes without a host round-trip; ZeRO-1 shards re-pad for
+        the new shard count bit-exactly via
+        ``ZeroPartitioner.repartition``); the host bounce survives only
+        as the fallback for the multi-controller path, where the world
+        re-init already cleared the backend and the controller
+        snapshotted state to host numpy first.
         """
+        old_zero = self._zero if self._opt_is_flat else None
         self._mesh = mesh
         # Mesh/accum-dependent caches: pad plans bake in the local batch
         # geometry, fused windows bake in shardings — both die with the
@@ -190,12 +222,29 @@ class CollectiveTrainer(Trainer):
         if mesh is not None:
             replicated = NamedSharding(mesh, P())
             self._batch_sharding = NamedSharding(mesh, P(self._data_axis))
-            self._params = jax.device_put(to_numpy(self._params), replicated)
-            self._opt_state = self._place_opt_state(
-                to_numpy(self._opt_state)
-            )
             self._replicated = replicated
+            self._zero = (
+                ZeroPartitioner(
+                    self._spec.optimizer, self._params, mesh,
+                    self._data_axis,
+                )
+                if self._zero1 else None
+            )
+            with self.timing.timeit("state_reshard"):
+                self._params = self._reshard_to(
+                    self._params, replicated
+                )
+                self._opt_state = self._place_opt_state(old_zero)
+            self._opt_is_flat = self._zero is not None
+            if self._zero is not None:
+                self._log_zero1_placement()
         else:
+            if old_zero is not None:  # leaving the mesh world entirely
+                self._opt_state = old_zero.gather_to_host(
+                    self._opt_state
+                )
+            self._opt_is_flat = False
+            self._zero = None
             self._batch_sharding = None
             self._replicated = None
         self._train_step = self._build_train_step()
@@ -203,30 +252,134 @@ class CollectiveTrainer(Trainer):
         self._local_eval_step = None  # rebuilt lazily: the old one may
         # belong to a cleared backend (world change)
 
-    def _opt_leaf_sharding(self, leaf):
-        """ZeRO-1 placement for one optimizer-state leaf: shard dim 0
-        over the data axis when divisible, replicate otherwise (scalars,
-        odd shapes)."""
-        n = self._mesh.shape[self._data_axis]
-        shape = np.shape(leaf)
-        if self._zero1 and shape and shape[0] % n == 0:
-            return NamedSharding(self._mesh, P(self._data_axis))
-        return NamedSharding(self._mesh, P())
+    def _reshard_to(self, tree, sharding):
+        """Place a pytree under ``sharding``, device-to-device when the
+        leaves are live device arrays (a committed array re-shards
+        across meshes without leaving the device fabric), straight
+        host->device when they are numpy.  Falls back to an explicit
+        host bounce only when the direct put fails (arrays from a
+        cleared backend that were never snapshotted)."""
+        def put(leaf):
+            if isinstance(leaf, jax.Array):
+                # A leaf already under the target sharding is a
+                # placement no-op — only book actual moves.
+                if getattr(leaf, "sharding", None) != sharding:
+                    self.timing.bump(
+                        "reshard_device_bytes", leaf.nbytes
+                    )
+            else:
+                self.timing.bump(
+                    "reshard_host_bytes", np.asarray(leaf).nbytes
+                )
+            return jax.device_put(leaf, sharding)
 
-    def _place_opt_state(self, opt_state):
-        if self._mesh is None:
-            return opt_state
-        return jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(
-                leaf, self._opt_leaf_sharding(leaf)
-            ),
-            opt_state,
-        )
+        try:
+            return jax.tree_util.tree_map(put, tree)
+        except Exception:  # noqa: BLE001 — dead backend arrays
+            logger.warning(
+                "device-to-device reshard unavailable; host bounce"
+            )
+            self.timing.bump("reshard_host_fallbacks")
+            return jax.device_put(to_numpy(tree), sharding)
+
+    def _place_opt_state(self, old_zero):
+        """Place the optimizer state for the current mesh/partitioner.
+
+        Live flat shards from a previous world re-partition
+        device-to-device (Adam moments preserved bit-exactly, see
+        ZeroPartitioner.repartition); original-shape state (first
+        build, post-snapshot, post-restore) is flattened host-side and
+        placed sharded; with zero1 off the state is simply (re)placed
+        replicated.  A dead-backend failure re-initializes moments from
+        params — the snapshot_to_host contract."""
+        state = self._opt_state
+        if self._zero is None:
+            return self._reshard_to(state, self._replicated)
+        try:
+            if old_zero is not None:
+                return self._zero.repartition(
+                    state, old_zero, timing=self.timing
+                )
+            return self._zero.place_state(to_numpy(state))
+        except Exception:  # noqa: BLE001 — dead backend / lost shards
+            logger.warning(
+                "zero1: live shard repartition failed; attempting "
+                "host bounce"
+            )
+            self.timing.bump("reshard_host_fallbacks")
+            try:
+                if old_zero is not None:
+                    state = old_zero.gather_to_host(state)
+                return self._zero.place_state(
+                    jax.tree_util.tree_map(np.asarray, state)
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "zero1: optimizer shards unrecoverable; "
+                    "re-initializing moments from params"
+                )
+                return self._zero.place_state(
+                    self._spec.optimizer.init(to_numpy(self._params))
+                )
 
     def _opt_out_shardings(self):
-        """Sharding tree matching the opt state for jit out_shardings."""
-        return jax.tree_util.tree_map(
-            lambda leaf: self._opt_leaf_sharding(leaf), self._opt_state
+        """Opt-state placement for jit in/out_shardings: the ZeRO-1
+        per-leaf tree when sharding is on, plain replicated otherwise
+        (the exact old path)."""
+        if self._zero is not None:
+            return self._zero.state_shardings(self._opt_state)
+        return self._replicated
+
+    def zero1_report(self):
+        """Per-device optimizer-state byte accounting, both modes.
+
+        Returns {mode, num_shards, per_device_bytes,
+        replicated_equiv_bytes, reduction_factor, padding_bytes,
+        scalar_leaves_replicated}; None without a mesh."""
+        if self._mesh is None:
+            return None
+        if self._zero is None:
+            total = sum(
+                getattr(leaf, "nbytes", None)
+                or np.asarray(leaf).nbytes
+                for leaf in jax.tree_util.tree_leaves(self._opt_state)
+            )
+            return {
+                "mode": "replicated",
+                "num_shards": int(self._mesh.shape[self._data_axis]),
+                "per_device_bytes": int(total),
+                "replicated_equiv_bytes": int(total),
+                "reduction_factor": 1.0,
+                "padding_bytes": 0,
+                "scalar_leaves_replicated": 0,
+            }
+        replicated, sharded, padding = self._zero.state_bytes(
+            self._opt_state
+        )
+        return {
+            "mode": "zero1",
+            "num_shards": self._zero.num_shards,
+            "per_device_bytes": int(sharded),
+            "replicated_equiv_bytes": int(replicated),
+            "reduction_factor": replicated / max(1, sharded),
+            "padding_bytes": int(padding),
+            "scalar_leaves_replicated": sum(
+                1 for s in self._zero.state_specs if s.padded == 0
+            ),
+        }
+
+    def _log_zero1_placement(self):
+        report = self.zero1_report()
+        logger.info(
+            "zero1: optimizer state sharded %d ways — %.3f MiB/device "
+            "(replicated would be %.3f MiB/device, %.1fx reduction; "
+            "%d padding bytes, %d scalar leaves replicated)",
+            report["num_shards"],
+            report["per_device_bytes"] / 2**20,
+            report["replicated_equiv_bytes"] / 2**20,
+            report["reduction_factor"],
+            report["padding_bytes"],
+            report["scalar_leaves_replicated"],
         )
 
     @property
@@ -287,9 +440,55 @@ class CollectiveTrainer(Trainer):
 
         return jax.value_and_grad(f)(params)
 
+    def _zero1_apply(self, tx, params, opt_state, grads):
+        """ZeRO-1 weight update: reduce-scatter(grads) -> shard-local
+        optimizer apply -> all-gather(params), expressed as sharding
+        constraints on the flat padded views (traceable; used inside
+        the jitted step).
+
+        Two numerics pins make the trajectory BIT-IDENTICAL to the
+        replicated path (measured over 100 steps, bench_zero.py), which
+        is what lets the elastic churn drills verify zero1 worlds
+        exactly:
+
+        1. grads are first constrained replicated — the cross-replica
+           sum lands at the same program point as the replicated path's
+           all-reduce, so the backward is never re-partitioned into a
+           different accumulation order.  The flat sharded constraint
+           right after is then a pure shard slice; on TPU, XLA's
+           reduce-scatter creator folds the sum+slice pair into a true
+           reduce-scatter.
+        2. an optimization barrier between the shard-local update and
+           the params all-gather — without it the partitioner
+           duplicates the update computation (one sharded copy for the
+           opt-state output, one differently-fused replicated copy for
+           the params output) and the copies disagree in the last ulp.
+
+        The scan carry of a fused window shrinks accordingly: opt state
+        rides through the window as 1/N-sized shards.
+        """
+        z = self._zero
+        shard_t = z.params_shardings(z.shard)
+        rep_t = z.params_shardings(z.replicated)
+        grads = jax.lax.with_sharding_constraint(grads, rep_t)
+        flat_g = jax.lax.with_sharding_constraint(
+            z.flatten_params(grads), shard_t
+        )
+        flat_p = jax.lax.with_sharding_constraint(
+            z.flatten_params(params), shard_t
+        )
+        updates, opt_state = tx.update(flat_g, opt_state, flat_p)
+        flat_new = optax.apply_updates(flat_p, updates)
+        flat_new, opt_state = jax.lax.optimization_barrier(
+            (flat_new, opt_state)
+        )
+        flat_new = jax.lax.with_sharding_constraint(flat_new, rep_t)
+        return z.unflatten_params(flat_new), opt_state
+
     def _build_train_step(self):
         tx = self._spec.optimizer
         accum = self._accum_steps
+        zero = self._zero
 
         def step(params, opt_state, features, labels, weights):
             if accum == 1:
@@ -312,15 +511,20 @@ class CollectiveTrainer(Trainer):
                 )
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 loss = loss_sum / accum
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if zero is not None:
+                params, opt_state = self._zero1_apply(
+                    tx, params, opt_state, grads
+                )
+            else:
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
         self._raw_step = step
         if self._mesh is None:
             return jax.jit(step, donate_argnums=(0, 1))
         rep = self._replicated
-        opt_sharding = self._opt_out_shardings() if self._zero1 else rep
+        opt_sharding = self._opt_out_shardings()
         if self._accum_steps == 1:
             batch_in = self._batch_sharding
         else:
@@ -360,7 +564,7 @@ class CollectiveTrainer(Trainer):
         if self._mesh is None:
             return jax.jit(multi, donate_argnums=(0, 1))
         rep = self._replicated
-        opt_sharding = self._opt_out_shardings() if self._zero1 else rep
+        opt_sharding = self._opt_out_shardings()
         return jax.jit(
             multi,
             in_shardings=(rep, opt_sharding, self._batch_sharding,
@@ -398,6 +602,12 @@ class CollectiveTrainer(Trainer):
         faster than the per-step loop on the same rig).  Compile time
         scales with num_steps — keep --fused_steps modest (4-16); each
         distinct window length compiles once and is cached.
+
+        With ``--zero1`` the window's opt-state carry is the flat
+        sharded form: each chained step hands its successor 1/N of the
+        optimizer state instead of a full replicated copy, which is
+        what shrinks the rolled-scan carry-copy cost the fused driver
+        measured (docs/training_pipeline.md has the carry-size math).
         """
         raw = self._raw_step
 
@@ -417,7 +627,7 @@ class CollectiveTrainer(Trainer):
         if self._mesh is None:
             return jax.jit(window, donate_argnums=(0, 1))
         rep = self._replicated
-        opt_sharding = self._opt_out_shardings() if self._zero1 else rep
+        opt_sharding = self._opt_out_shardings()
         batch_in = self._window_batch_sharding()
         return jax.jit(
             window,
@@ -525,9 +735,22 @@ class CollectiveTrainer(Trainer):
                 self._params, self._opt_state,
                 prepared.features, prepared.labels, prepared.weights,
             )
+        self._count_zero1_traffic(1)
         self._version += 1
         self._maybe_report_and_checkpoint()
         return loss, self._version
+
+    def _count_zero1_traffic(self, steps):
+        """Logical collective payload accounting: each zero1 step
+        reduce-scatters one flat grads tree and all-gathers one flat
+        params tree (byte counts are the annotated payload sizes, not
+        a wire capture — surfaced under Timing.summary()['zero1'])."""
+        if self._zero is None:
+            return
+        flat_bytes = self._zero.flat_param_bytes()
+        self.timing.bump("zero1_reduce_scatter_bytes",
+                         flat_bytes * steps)
+        self.timing.bump("zero1_all_gather_bytes", flat_bytes * steps)
 
     # -- fused window API (fused_driver.FusedStepDriver) --------------------
 
@@ -613,6 +836,7 @@ class CollectiveTrainer(Trainer):
                     self._params, self._opt_state,
                     staged.features, staged.labels, staged.weights,
                 )
+        self._count_zero1_traffic(staged.size)
         self._version += staged.size
         self._maybe_report_and_checkpoint()
         return losses, self._version
@@ -691,17 +915,25 @@ class CollectiveTrainer(Trainer):
     def set_params(self, params):
         self._params = params
         self._opt_state = self._spec.optimizer.init(params)
+        self._opt_is_flat = False
         if self._mesh is not None:
-            self._params = jax.device_put(
-                to_numpy(self._params), self._replicated
+            self._params = self._reshard_to(
+                self._params, self._replicated
             )
-            self._opt_state = self._place_opt_state(
-                to_numpy(self._opt_state)
-            )
+            self._opt_state = self._place_opt_state(old_zero=None)
+            self._opt_is_flat = self._zero is not None
 
     def export_parameters(self):
         named, _ = flatten_with_names(to_numpy(self._params))
         return named
+
+    def _opt_state_on_host(self):
+        """Original-shape HOST view of the optimizer state.  ZeRO-1
+        shards are gathered on-device through the unpadding view first
+        (multi-controller safe); replicated state converts directly."""
+        if self._opt_is_flat and self._zero is not None:
+            return self._zero.gather_to_host(self._opt_state)
+        return to_numpy(self._opt_state)
 
     def serving_bundle(self):
         """(inference_fn, params, example_input) for the servable
@@ -725,10 +957,17 @@ class CollectiveTrainer(Trainer):
         donation invalidates the old arrays), but the disk write runs on
         a single background thread so the train loop only ever pays
         transfer time, not serialization+IO.  ``flush_checkpoints``
-        joins pending writes (called at train end)."""
+        joins pending writes (called at train end).
+
+        ZeRO-1 state is checkpointed through its unpadding view
+        (``_opt_state_on_host``): the file always holds original-shape
+        leaves, so checkpoints are byte-portable between ``--zero1``
+        on and off, and the on-device all-gather makes the host
+        transfer safe in multi-controller worlds (raw ``to_numpy`` on
+        a sharded leaf would hit non-addressable shards)."""
         with self.timing.timeit("checkpoint_save"):
             payload = dict(self.export_parameters())
-            opt_named, _ = flatten_with_names(to_numpy(self._opt_state))
+            opt_named, _ = flatten_with_names(self._opt_state_on_host())
             payload.update({"opt/" + k: v for k, v in opt_named.items()})
             if self._ckpt_executor is None:
                 from concurrent.futures import ThreadPoolExecutor
@@ -798,10 +1037,20 @@ class CollectiveTrainer(Trainer):
         )
         fresh_opt = True
         if opt_named:
+            # Checkpoints hold ORIGINAL leaf shapes; restore against an
+            # original-shape skeleton (a flat ZeRO-1 live state would
+            # reject every leaf on shape) — rebuild() re-flattens and
+            # re-shards below.
+            template = (
+                self._spec.optimizer.init(to_numpy(self._params))
+                if self._opt_is_flat
+                else to_numpy(self._opt_state)
+            )
             try:
                 self._opt_state = unflatten_from_names(
-                    to_numpy(self._opt_state), opt_named
+                    template, opt_named
                 )
+                self._opt_is_flat = False
                 fresh_opt = False
             except (KeyError, ValueError) as e:
                 # Optimizer changed since the checkpoint (e.g. Adam ->
@@ -812,6 +1061,7 @@ class CollectiveTrainer(Trainer):
                 )
         if fresh_opt:  # pre-opt-state checkpoint or structure mismatch
             self._opt_state = self._spec.optimizer.init(self._params)
+            self._opt_is_flat = False
         if self._mesh is not None:
             self.rebuild(self._mesh)
         self._version = version

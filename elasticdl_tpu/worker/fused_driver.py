@@ -20,6 +20,13 @@ construction*:
    one task-final fence (so a task is reported complete only after its
    last window verifiably finished), and one fence on preemption.
 
+Composes with ``--zero1`` (ZeRO-1 weight-update sharding): the fused
+window's opt-state carry is then the flat sharded form — each chained
+step hands 1/N of the optimizer state to the next instead of a full
+replicated copy — and window dispatches count their reduce-scatter /
+all-gather payloads into ``Timing.summary()['zero1']``
+(docs/training_pipeline.md has the carry-size math).
+
 Elasticity is preserved because the window is **clamped** to the
 distance to the next report/version/checkpoint/log/elastic-check
 boundary (``_window_limit``) and to the task's remaining batches (the

@@ -127,6 +127,18 @@ def build_worker(args):
     )
     if saver is not None:
         trainer.init_from_checkpoint()
+    mem = trainer.zero1_report()
+    if mem is not None:
+        # Startup accounting for the operator: what one device holds in
+        # optimizer state under the chosen placement, and what the
+        # other mode would cost (rebuild() logs the same line again on
+        # every elastic re-form).
+        logger.info(
+            "optimizer state per device: %d bytes (%s, %d devices; "
+            "replicated equivalent %d bytes, %.1fx)",
+            mem["per_device_bytes"], mem["mode"], mem["num_shards"],
+            mem["replicated_equiv_bytes"], mem["reduction_factor"],
+        )
     elastic = None
     if args.distribution_strategy == "collective":
         # Managed elastic AllReduce: the controller consumes the
