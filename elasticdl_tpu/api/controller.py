@@ -161,7 +161,16 @@ class ElasticCollectiveController:
             # us (epoch bumps again -> rank >= 0 -> rebuild).
             if changed:
                 self.leave_world()
-                self._mc.report_train_loop_status(pb.LOOP_START)
+            # Announce even when the id did NOT change: a master
+            # restarted from its journal re-arms at journaled+1, which
+            # can EQUAL the un-journaled id this worker glimpsed just
+            # before the crash — same id, empty committed world,
+            # rank=-1 — and with no pending member the restarted
+            # master would never commit again.  LOOP_START is
+            # idempotent on the master (add_worker no-ops while the
+            # host is already pending), so repeating it at the check
+            # cadence is safe.
+            self._mc.report_train_loop_status(pb.LOOP_START)
             return False
         if changed or not self._first_init_done:
             self._reinit_world()
@@ -247,10 +256,17 @@ class ElasticCollectiveController:
                 self._last_check = time.time()
                 self._steps_since_check = 0
                 return True
-            if epoch_seen and not announced:
-                # Excluded from the new world: detach from the doomed
-                # old epoch (its service gets reaped) and re-announce
-                # so the master's next commit re-admits us.
+            if not announced and (
+                epoch_seen or self._rendezvous.rank < 0
+            ):
+                # Excluded from the new world — or orphaned at an
+                # UNCHANGED id by a master that restarted from its
+                # journal at exactly the id we glimpsed before the
+                # crash (rank=-1 against its empty committed world, so
+                # no new epoch will ever commit unless we announce):
+                # detach from the doomed old epoch (its service gets
+                # reaped) and re-announce so the master's next commit
+                # re-admits us.
                 self.leave_world()
                 self._mc.report_train_loop_status(pb.LOOP_START)
                 announced = True

@@ -7,6 +7,7 @@ import time
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils import grpc_utils
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.retry import RetryPolicy
 from elasticdl_tpu.worker.master_client import MasterClient
 
 logger = get_logger(__name__)
@@ -15,7 +16,18 @@ logger = get_logger(__name__)
 class JobMonitor:
     def __init__(self, master_addr, poll_secs=10):
         channel = grpc_utils.build_channel(master_addr)
-        self._mc = MasterClient(channel, worker_id=-2)
+        # FAIL-FAST policy, not the 120 s outage-riding default: this
+        # client's job is to DETECT an unreachable master — riding the
+        # outage would stall every probe for the full retry budget and
+        # stretch watch()'s unreachability verdict by minutes.
+        self._mc = MasterClient(
+            channel, worker_id=-2,
+            retry=RetryPolicy(
+                name="job_monitor", max_attempts=2,
+                deadline_secs=2.0, base_delay_secs=0.2,
+                max_delay_secs=0.5,
+            ),
+        )
         self._poll_secs = poll_secs
 
     def snapshot(self):

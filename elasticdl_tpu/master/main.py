@@ -30,8 +30,42 @@ _MASTER_ONLY_ARGS = (
     "grads_to_wait", "sync_version_tolerance",
     "worker_backend", "image", "namespace", "worker_resource_request",
     "tpu_topology", "worker_pod_priority", "cluster_spec", "volume",
-    "status_port",
+    "status_port", "journal_dir", "rpc_fault_spec",
 )
+
+# Job-config fields that must match between the journal and a
+# restarted master's flags: replaying a journal into a DIFFERENT job
+# (other dataset, other task split) would rebuild nonsense queues.
+_JOURNAL_META_FIELDS = (
+    "job_name", "job_type", "data_origin", "records_per_task",
+    "num_epochs", "seed", "shuffle", "shuffle_shards",
+)
+
+
+def _journal_meta(args, records_per_task):
+    meta = {
+        field: getattr(args, field) for field in _JOURNAL_META_FIELDS
+        if field != "records_per_task"
+    }
+    meta["records_per_task"] = records_per_task
+    return meta
+
+
+def _check_journal_meta(state, meta):
+    if state.meta is None:
+        logger.warning("journal has no meta record; replaying anyway")
+        return
+    mismatched = {
+        k: (state.meta.get(k), meta[k])
+        for k in meta if state.meta.get(k) != meta[k]
+    }
+    if mismatched:
+        raise RuntimeError(
+            "journal replay refused: the journaled job does not match "
+            "this master's flags (journaled vs current): %r — point "
+            "--journal_dir at a fresh directory for a new job"
+            % mismatched
+        )
 
 
 def _build_worker_backend(args, worker_args):
@@ -60,6 +94,11 @@ def _build_worker_backend(args, worker_args):
 
 def build_master(args):
     records_per_task = args.batch_size * args.num_minibatches_per_task
+    journal_state = None
+    if args.journal_dir:
+        from elasticdl_tpu.master.journal import replay_journal
+
+        journal_state = replay_journal(args.journal_dir)
     reader = create_data_reader(
         args.data_origin, records_per_shard=records_per_task
     )
@@ -93,17 +132,42 @@ def build_master(args):
             ),
             **common,
         )
-    if args.job_type == "train" and args.checkpoint_dir:
-        # Resume: the checkpoint version counts optimizer steps; skip the
-        # records those steps consumed so epoch 1 continues where the
-        # previous run stopped.
-        from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+    journal = None
+    if args.journal_dir:
+        from elasticdl_tpu.master.journal import JournalWriter
 
-        latest = CheckpointSaver(
-            args.checkpoint_dir
-        ).latest_resumable_version(max(args.num_ps, 1))
-        if latest:
-            task_manager.skip_records(latest * args.batch_size)
+        journal = JournalWriter(args.journal_dir)
+    if journal_state is not None:
+        # Master crash-restart: the journal is the exact task/progress
+        # state — replaying it supersedes the checkpoint-version
+        # skip_records approximation below.
+        _check_journal_meta(
+            journal_state, _journal_meta(args, records_per_task)
+        )
+        task_manager.restore_from_journal(journal_state)
+        journal.append({"ev": "restart"})
+        journal.flush()
+        task_manager.attach_journal(journal, bootstrap=False)
+    else:
+        if journal is not None:
+            journal.append(
+                {"ev": "meta",
+                 "job": _journal_meta(args, records_per_task)}
+            )
+            # Attach BEFORE any checkpoint skip below, so the skip's
+            # done/trim events land in the journal too.
+            task_manager.attach_journal(journal, bootstrap=True)
+        if args.job_type == "train" and args.checkpoint_dir:
+            # Resume: the checkpoint version counts optimizer steps;
+            # skip the records those steps consumed so epoch 1
+            # continues where the previous run stopped.
+            from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+
+            latest = CheckpointSaver(
+                args.checkpoint_dir
+            ).latest_resumable_version(max(args.num_ps, 1))
+            if latest:
+                task_manager.skip_records(latest * args.batch_size)
     spec = load_model_spec(args.model_zoo,
                            model_params=args.model_params)
     evaluation_service = None
@@ -170,6 +234,13 @@ def build_master(args):
                     check_steps=max(1, args.num_minibatches_per_task)
                 ),
             ).start_epoch,
+            journal=journal,
+            # Restart re-arms STRICTLY past every epoch a worker can
+            # hold (journaled id, +1 for an un-journaled commit racing
+            # the crash) so reconnecting workers re-form at a fresh id.
+            initial_epoch=(
+                journal_state.rendezvous_id + 1 if journal_state else 0
+            ),
         )
     ps_manager = None
     if args.distribution_strategy == "ps" and args.num_ps > 0:
@@ -205,13 +276,27 @@ def build_master(args):
         from elasticdl_tpu.client.k8s_submit import MASTER_PORT
 
         port = MASTER_PORT
+    interceptors = None
+    if args.rpc_fault_spec:
+        from elasticdl_tpu.utils.grpc_utils import (
+            FaultInjectionInterceptor,
+        )
+
+        logger.warning(
+            "RPC fault injection armed: %s", args.rpc_fault_spec
+        )
+        interceptors = [FaultInjectionInterceptor(args.rpc_fault_spec)]
     master = Master(
         task_manager,
         rendezvous_server=rendezvous,
         evaluation_service=evaluation_service,
         worker_manager=worker_manager,
         port=port,
+        journal=journal,
+        interceptors=interceptors,
     )
+    if journal_state is not None:
+        master.servicer.restore_from_journal(journal_state)
     if args.worker_backend == "k8s":
         # Workers in other pods reach the master by its service DNS
         # name, not localhost (the service the submit path created).
@@ -249,6 +334,8 @@ def main(argv=None):
             master.ps_manager.stop()
         if status_server is not None:
             status_server.stop()
+        if master.journal is not None:
+            master.journal.close()
 
 
 if __name__ == "__main__":

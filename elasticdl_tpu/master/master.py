@@ -24,6 +24,8 @@ class Master:
         worker_manager=None,
         port=0,
         poll_secs=1.0,
+        journal=None,
+        interceptors=None,
     ):
         self.task_manager = task_manager
         self.rendezvous_server = rendezvous_server
@@ -32,6 +34,11 @@ class Master:
         self._port = port
         self._poll_secs = poll_secs
         self._server = None
+        # Crash-restart recovery: the job-state journal (owned by
+        # main, threaded into every journaling component) and optional
+        # server interceptors (fault injection for drills).
+        self.journal = journal
+        self._interceptors = interceptors
         self.port = None
         # How managed workers dial back.  None = "localhost:<port>"
         # (process backend).  A k8s master advertises its service DNS
@@ -43,6 +50,7 @@ class Master:
             rendezvous_server=rendezvous_server,
             evaluation_service=evaluation_service,
             worker_manager=worker_manager,
+            journal=journal,
         )
 
     def prepare(self):
@@ -56,7 +64,8 @@ class Master:
         )
         self.task_manager.start()
         self._server, self.port = create_master_service(
-            self.servicer, port=self._port
+            self.servicer, port=self._port,
+            interceptors=self._interceptors,
         )
         if self.worker_manager is not None:
             addr = self.advertise_addr or "localhost:%d"
@@ -129,3 +138,7 @@ class Master:
         if self._server is not None:
             self._server.stop(grace=1)
             self._server = None
+        if self.journal is not None:
+            # Flush any buffered progress events; the journal stays
+            # open for late lifecycle appends (close is owned by main).
+            self.journal.flush()

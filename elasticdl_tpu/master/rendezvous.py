@@ -19,7 +19,8 @@ logger = get_logger(__name__)
 
 
 class RendezvousServer:
-    def __init__(self, grace_secs=2.0, coordinator_factory=None):
+    def __init__(self, grace_secs=2.0, coordinator_factory=None,
+                 journal=None, initial_epoch=0):
         """``coordinator_factory(world_size) -> addr`` (optional): run
         at every epoch commit to stand up that epoch's coordination
         plane — in production ``MasterCoordinationService.start_epoch``
@@ -27,15 +28,32 @@ class RendezvousServer:
         service on the MASTER so worker churn can never strand the
         survivors.  Without a factory the address set via
         ``set_coordinator_addr`` is advertised unchanged (legacy:
-        worker 0 hosts the service)."""
+        worker 0 hosts the service).
+
+        ``journal``/``initial_epoch`` (master/journal.py): every epoch
+        commit is made durable BEFORE it is published (staged under
+        the lock, journaled outside it, only then visible to
+        ``get_comm_rank``), so a restarted master's ``initial_epoch =
+        journaled_id + 1`` is strictly above any id a surviving
+        worker can hold.  Reconnecting workers see rank=-1 against
+        the empty committed world, re-announce LOOP_START (the
+        controller announces on rank=-1 even when the id looks
+        unchanged — defense in depth should the journal tail ever be
+        lost to more than a crash), and re-form at the first
+        post-restart commit."""
         self._lock = threading.Lock()
         self._grace_secs = grace_secs
         self._coordinator_factory = coordinator_factory
+        self._journal = journal
         self._cur_hosts = []     # committed world, sorted by join order
         self._next_hosts = []    # pending world
-        self._rendezvous_id = 0
+        self._rendezvous_id = int(initial_epoch)
         self._last_change = None
         self._coordinator_addr = ""
+        # True while a staged commit is being made durable (journal
+        # write outside the lock); blocks a second concurrent stage
+        # from minting a colliding id.
+        self._commit_inflight = False
 
     def set_coordinator_addr(self, addr):
         with self._lock:
@@ -65,7 +83,14 @@ class RendezvousServer:
                 self._last_change = time.time()
                 logger.info("rendezvous: worker %s leaving", host)
 
-    def _maybe_commit_locked(self):
+    def _maybe_stage_commit_locked(self):
+        """Stage a pending membership change WITHOUT publishing it:
+        returns ``{"hosts", "n", "addr"}`` for the caller to journal
+        (file I/O, outside the lock — EL006) and then publish, or
+        None.  While one stage is in flight no second commit can be
+        minted, so ids never collide."""
+        if self._commit_inflight:
+            return None
         if (
             self._next_hosts != self._cur_hosts
             and self._last_change is not None
@@ -88,15 +113,13 @@ class RendezvousServer:
                         "epoch commit", e,
                     )
                     self._last_change = time.time()
-                    return
-            self._cur_hosts = new_hosts
-            self._rendezvous_id += 1
-            self._coordinator_addr = addr
-            logger.info(
-                "rendezvous epoch %d: world=%s coordinator=%s",
-                self._rendezvous_id, self._cur_hosts,
-                self._coordinator_addr,
-            )
+                    return None
+            self._commit_inflight = True
+            return {
+                "hosts": new_hosts, "n": self._rendezvous_id + 1,
+                "addr": addr,
+            }
+        return None
 
     def get_comm_rank(self, host):
         """Return (rank, world_size, rendezvous_id, coordinator_addr).
@@ -105,7 +128,41 @@ class RendezvousServer:
         should keep polling.
         """
         with self._lock:
-            self._maybe_commit_locked()
+            staged = self._maybe_stage_commit_locked()
+        if staged is not None:
+            # Durable BEFORE visible: no worker may observe an epoch
+            # id the journal could lose.  The flush is synchronous and
+            # deliberate — commits are rare (one per membership
+            # change, behind a grace window) — and because nothing is
+            # published until the record is on disk, a restarted
+            # master's ``initial_epoch = journaled + 1`` is strictly
+            # above every id any worker can hold, however many
+            # commits were in flight at the crash.  Concurrent pollers
+            # meanwhile see the previous epoch and simply poll again.
+            if self._journal is not None:
+                try:
+                    self._journal.append(
+                        {"ev": "rdzv", "n": staged["n"],
+                         "hosts": list(staged["hosts"])}
+                    )
+                    self._journal.flush()
+                except Exception:
+                    # Un-stage so a later poll can retry the commit;
+                    # nothing was published, so no worker saw the id.
+                    with self._lock:
+                        self._commit_inflight = False
+                    raise
+            with self._lock:
+                self._cur_hosts = staged["hosts"]
+                self._rendezvous_id = staged["n"]
+                self._coordinator_addr = staged["addr"]
+                self._commit_inflight = False
+                logger.info(
+                    "rendezvous epoch %d: world=%s coordinator=%s",
+                    self._rendezvous_id, self._cur_hosts,
+                    self._coordinator_addr,
+                )
+        with self._lock:
             if host in self._cur_hosts:
                 rank = self._cur_hosts.index(host)
             else:

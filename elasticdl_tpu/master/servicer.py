@@ -25,16 +25,30 @@ class MasterServicer:
         rendezvous_server=None,
         evaluation_service=None,
         worker_manager=None,
+        journal=None,
     ):
         self._task_manager = task_manager
         self._rendezvous = rendezvous_server
         self._evaluation_service = evaluation_service
         self._worker_manager = worker_manager
         self._lock = threading.Lock()
+        # Progress events stream to the job journal BUFFERED (they are
+        # the hot path; a crash loses at most one flush window of
+        # observability counts — task accounting is exact).  Appends
+        # run outside self._lock (EL006).
+        self._journal = journal
         self._version = 0
         self.training_params = None
         self.worker_record_counts = {}  # worker_id -> records processed
         self.worker_exec_counters = {}  # counter name -> total
+
+    def restore_from_journal(self, state):
+        """Master restart: resume the version high-water mark and the
+        per-worker progress counts from the replayed journal."""
+        with self._lock:
+            self._version = max(self._version, state.model_version)
+            for worker_id, n in state.worker_records.items():
+                self.worker_record_counts[worker_id] = n
 
     @property
     def model_version(self):
@@ -82,7 +96,9 @@ class MasterServicer:
             # completion, or one bad shard wedges evaluation forever.
             and (result.ok or result.permanent_failure)
         ):
-            self._evaluation_service.complete_task()
+            self._evaluation_service.complete_task(
+                model_version=result.task.model_version
+            )
         return pb.Empty()
 
     @rpc_error_guard
@@ -91,6 +107,11 @@ class MasterServicer:
             prev = self.worker_record_counts.get(request.worker_id, 0)
             self.worker_record_counts[request.worker_id] = (
                 prev + request.record_count
+            )
+        if self._journal is not None:
+            self._journal.append(
+                {"ev": "batch", "w": request.worker_id,
+                 "n": request.record_count}
             )
         return pb.Empty()
 
@@ -133,14 +154,20 @@ class MasterServicer:
             if len(outputs) == 1:
                 outputs = next(iter(outputs.values()))
             self._evaluation_service.report_evaluation_metrics(
-                outputs, labels
+                outputs, labels,
+                model_version=request.model_version,
             )
         return pb.Empty()
 
     @rpc_error_guard
     def report_version(self, request, _context=None):
         with self._lock:
+            advanced = request.model_version > self._version
             self._version = max(self._version, request.model_version)
+        if advanced and self._journal is not None:
+            self._journal.append(
+                {"ev": "version", "v": request.model_version}
+            )
         if self._evaluation_service is not None:
             self._evaluation_service.add_evaluation_task_if_needed(
                 request.model_version
@@ -153,9 +180,15 @@ class MasterServicer:
         return pb.Empty()
 
 
-def create_master_service(servicer, port=0, max_workers=64):
-    """Start an in-process gRPC master service; returns (server, port)."""
-    server = grpc_utils.build_server(max_workers=max_workers)
+def create_master_service(servicer, port=0, max_workers=64,
+                          interceptors=None):
+    """Start an in-process gRPC master service; returns (server, port).
+
+    ``interceptors``: e.g. a grpc_utils.FaultInjectionInterceptor —
+    drills script deterministic master outages with --rpc_fault_spec."""
+    server = grpc_utils.build_server(
+        max_workers=max_workers, interceptors=interceptors
+    )
     rpc.add_master_servicer(servicer, server)
     bound = server.add_insecure_port("[::]:%d" % port)
     server.start()

@@ -14,6 +14,7 @@ import threading
 import time
 from collections import deque, namedtuple
 
+from elasticdl_tpu.master.journal import journal_events
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils.logging import get_logger
 
@@ -106,6 +107,13 @@ class TaskManager:
         self._doing = {}
         self._task_id = 0
         self._epoch = 0
+        # Crash-restart recovery (master/journal.py): lifecycle events
+        # stream to the journal (appended OUTSIDE self._lock — EL006
+        # proves it); _done_ids lets a restarted master deduplicate a
+        # worker re-reporting a task the pre-crash master already
+        # counted, so nothing is double-counted across a restart.
+        self._journal = None
+        self._done_ids = set()
         self._train_end_callback_pending = False
         self._train_end_callback_done = False
         self._max_task_completed_time = 0.0
@@ -142,7 +150,19 @@ class TaskManager:
                 pos = chunk_end
         return out
 
-    def _create_tasks_locked(self, shards, task_type, model_version=-1):
+    @staticmethod
+    def _task_event(task):
+        event = {
+            "ev": "task", "id": task.id, "type": task.type,
+            "name": task.shard.name, "start": task.shard.start,
+            "end": task.shard.end, "mv": task.model_version,
+        }
+        if task.shard.record_indices:
+            event["idx"] = list(task.shard.record_indices)
+        return event
+
+    def _create_tasks_locked(self, shards, task_type, model_version=-1,
+                             events=None):
         pieces = self._split(shards)
         if task_type == pb.TRAINING and self._shuffle_shards:
             self._rng.shuffle(pieces)
@@ -156,10 +176,14 @@ class TaskManager:
             self._task_id += 1
             tasks.append(Task(self._task_id, piece, task_type, model_version))
         self._todo.extend(tasks)
+        if events is not None:
+            events.extend(self._task_event(t) for t in tasks)
         return tasks
 
-    def _create_training_tasks_locked(self):
-        self._create_tasks_locked(self._training_shards, pb.TRAINING)
+    def _create_training_tasks_locked(self, events=None):
+        self._create_tasks_locked(
+            self._training_shards, pb.TRAINING, events=events
+        )
 
     def skip_records(self, num_records):
         """Drop already-trained records after a checkpoint resume
@@ -167,6 +191,7 @@ class TaskManager:
         version, task_manager.py:208-221).  Whole tasks are dropped while
         their full span fits in num_records; the remainder trims the next
         task's front."""
+        events = []
         with self._lock:
             skipped = 0
             while self._todo and num_records - skipped > 0:
@@ -176,6 +201,8 @@ class TaskManager:
                     self._todo.popleft()
                     skipped += size
                     self.completed_counts[task.type] += 1
+                    self._done_ids.add(task.id)
+                    events.append({"ev": "done", "id": task.id})
                 else:
                     trim = num_records - skipped
                     task.shard.start += trim
@@ -184,51 +211,73 @@ class TaskManager:
                             task.shard.record_indices[trim:]
                         )
                     skipped += trim
+                    events.append(
+                        {"ev": "trim", "id": task.id,
+                         "start": task.shard.start}
+                    )
             logger.info("resume: skipped %d records", skipped)
-            return skipped
+        journal_events(self._journal, events)
+        return skipped
 
     def create_evaluation_tasks(self, model_version):
         """Version-triggered eval job (reference task_manager create_evaluation_tasks)."""
+        events = []
         with self._lock:
             tasks = self._create_tasks_locked(
-                self._evaluation_shards, pb.EVALUATION, model_version
+                self._evaluation_shards, pb.EVALUATION, model_version,
+                events=events,
             )
             # Evaluation interleaves ahead of remaining training tasks.
             for _ in tasks:
                 self._todo.rotate(1)
-            return len(tasks)
+            n = len(tasks)
+        journal_events(self._journal, events)
+        return n
 
     def set_train_end_callback_task(self):
         with self._lock:
             self._train_end_callback_pending = True
+        journal_events(self._journal, [{"ev": "cb"}])
 
     # -- dispatch -----------------------------------------------------------
 
     def get(self, worker_id):
         """Pop the next task for a worker; None when nothing is available."""
+        events = []
         with self._lock:
-            if not self._todo and not self._doing:
-                if self._epoch < self._num_epochs - 1 and self._training_shards:
-                    self._epoch += 1
-                    logger.info("starting epoch %d", self._epoch)
-                    self._create_training_tasks_locked()
-                elif (
-                    self._train_end_callback_pending
-                    and not self._train_end_callback_done
-                    and self._finished_training_locked()
-                ):
-                    self._train_end_callback_done = True
-                    self._task_id += 1
-                    task = Task(
-                        self._task_id, Shard("", 0, 0), pb.TRAIN_END_CALLBACK
-                    )
-                    self._doing[task.id] = (worker_id, task, time.time())
-                    return task
-            if not self._todo:
-                return None
-            task = self._todo.popleft()
-            self._doing[task.id] = (worker_id, task, time.time())
-            return task
+            task = self._get_locked(worker_id, events)
+        journal_events(self._journal, events)
+        return task
+
+    def _get_locked(self, worker_id, events):
+        if not self._todo and not self._doing:
+            if self._epoch < self._num_epochs - 1 and self._training_shards:
+                self._epoch += 1
+                logger.info("starting epoch %d", self._epoch)
+                events.append({"ev": "epoch", "n": self._epoch})
+                self._create_training_tasks_locked(events=events)
+            elif (
+                self._train_end_callback_pending
+                and not self._train_end_callback_done
+                and self._finished_training_locked()
+            ):
+                self._train_end_callback_done = True
+                self._task_id += 1
+                task = Task(
+                    self._task_id, Shard("", 0, 0), pb.TRAIN_END_CALLBACK
+                )
+                self._doing[task.id] = (worker_id, task, time.time())
+                events.append(self._task_event(task))
+                events.append(
+                    {"ev": "dispatch", "id": task.id, "w": worker_id}
+                )
+                return task
+        if not self._todo:
+            return None
+        task = self._todo.popleft()
+        self._doing[task.id] = (worker_id, task, time.time())
+        events.append({"ev": "dispatch", "id": task.id, "w": worker_id})
+        return task
 
     def report(self, task_id, success, err_message="", requeue=False):
         """Worker reports a task result; failed tasks are retried <=N times.
@@ -238,39 +287,202 @@ class TaskManager:
         and without counting completion — the task was only peeked,
         never worked.
 
+        Replay safety across a master restart: a report for a task the
+        journaled master already completed is deduplicated (idempotent
+        success), and a success report for a task sitting in the todo
+        queue (requeued on restart while its worker rode out the
+        outage) completes it from the queue — the task is neither
+        double-counted nor re-trained.
+
         Returns a ReportResult.
         """
+        events = []
         with self._lock:
-            entry = self._doing.pop(task_id, None)
-            if entry is None:
-                logger.warning("report for unknown task %d", task_id)
-                return ReportResult(False, None, False)
-            worker_id, task, start_time = entry
-            if requeue:
-                logger.info("task %d handed back by observer", task_id)
-                self._todo.appendleft(task)
-                return ReportResult(False, task, False)
-            if success:
-                elapsed = time.time() - start_time
-                self._max_task_completed_time = max(
-                    self._max_task_completed_time, elapsed
-                )
-                self.completed_counts[task.type] += 1
-                return ReportResult(True, task, False)
-            task.retry_count += 1
-            if task.retry_count <= self._max_task_retries:
-                logger.info(
-                    "task %d failed (%s), retry %d/%d",
-                    task_id, err_message, task.retry_count,
-                    self._max_task_retries,
-                )
-                self._todo.appendleft(task)
-                return ReportResult(False, task, False)
-            logger.error(
-                "task %d permanently failed: %s", task_id, err_message
+            result = self._report_locked(
+                task_id, success, err_message, requeue, events
             )
-            self.failed_counts[task.type] += 1
-            return ReportResult(False, task, True)
+        journal_events(self._journal, events)
+        return result
+
+    def _report_locked(self, task_id, success, err_message, requeue,
+                       events):
+        entry = self._doing.pop(task_id, None)
+        if entry is None:
+            return self._report_undispatched_locked(
+                task_id, success, err_message, requeue, events
+            )
+        worker_id, task, start_time = entry
+        if requeue:
+            logger.info("task %d handed back by observer", task_id)
+            self._todo.appendleft(task)
+            events.append({"ev": "requeue", "id": task_id})
+            return ReportResult(False, task, False)
+        if success:
+            elapsed = time.time() - start_time
+            self._max_task_completed_time = max(
+                self._max_task_completed_time, elapsed
+            )
+            return self._complete_locked(task, events)
+        return self._fail_locked(task, err_message, events)
+
+    def _report_undispatched_locked(self, task_id, success, err_message,
+                                    requeue, events):
+        """A report for a task not in doing: either a duplicate of an
+        already-counted completion (master restarted after journaling
+        it) or a task the restart requeued while its worker kept
+        working through the outage."""
+        if task_id in self._done_ids:
+            logger.info(
+                "task %d already completed; duplicate report "
+                "deduplicated", task_id,
+            )
+            return ReportResult(True, None, False)
+        task = next(
+            (t for t in self._todo if t.id == task_id), None
+        )
+        if task is None:
+            logger.warning("report for unknown task %d", task_id)
+            return ReportResult(False, None, False)
+        if requeue:
+            # Observer hand-back (e.g. graceful preemption) racing the
+            # restart's own requeue: the task is already back in todo —
+            # leave it there, and honor the no-retry-burned contract.
+            logger.info(
+                "task %d handed back by observer; already requeued",
+                task_id,
+            )
+            return ReportResult(False, task, False)
+        if success:
+            self._todo.remove(task)
+            logger.info(
+                "task %d completed by a worker that rode out a master "
+                "restart; accepting from the requeued state", task_id,
+            )
+            return self._complete_locked(task, events)
+        # Failure report for a task sitting in todo: it is ALREADY
+        # queued for re-dispatch, so requeue is the right outcome and
+        # it has happened.  Do not burn a retry — under the client's
+        # RPC retry a processed-failure-with-lost-response is reported
+        # twice, and charging both would permanently fail a task after
+        # half its real budget.  A genuinely poisoned task still burns
+        # retries normally once re-dispatched (it fails from _doing).
+        logger.info(
+            "task %d failure reported (%s) while already requeued; "
+            "keeping queued without charging a retry",
+            task_id, err_message or "unspecified",
+        )
+        return ReportResult(False, task, False)
+
+    def _complete_locked(self, task, events):
+        self.completed_counts[task.type] += 1
+        self._done_ids.add(task.id)
+        events.append({"ev": "done", "id": task.id})
+        return ReportResult(True, task, False)
+
+    def _fail_locked(self, task, err_message, events):
+        task.retry_count += 1
+        if task.retry_count <= self._max_task_retries:
+            logger.info(
+                "task %d failed (%s), retry %d/%d",
+                task.id, err_message, task.retry_count,
+                self._max_task_retries,
+            )
+            self._todo.appendleft(task)
+            events.append(
+                {"ev": "fail", "id": task.id, "perm": False,
+                 "retries": task.retry_count}
+            )
+            return ReportResult(False, task, False)
+        logger.error(
+            "task %d permanently failed: %s", task.id, err_message
+        )
+        self.failed_counts[task.type] += 1
+        events.append(
+            {"ev": "fail", "id": task.id, "perm": True,
+             "retries": task.retry_count}
+        )
+        return ReportResult(False, task, True)
+
+    # -- crash-restart recovery (master/journal.py) -------------------------
+
+    def attach_journal(self, journal, bootstrap=True):
+        """Start streaming lifecycle events to ``journal``.
+
+        ``bootstrap=True`` (fresh start) journals the current queue so
+        replay can rebuild it; a restarted master attaches with
+        ``bootstrap=False`` — its state CAME from the journal, and
+        re-journaling it would duplicate every task record."""
+        events = []
+        if bootstrap:
+            with self._lock:
+                if self._epoch:
+                    events.append({"ev": "epoch", "n": self._epoch})
+                events.extend(self._task_event(t) for t in self._todo)
+                if self._train_end_callback_pending:
+                    events.append({"ev": "cb"})
+        self._journal = journal
+        journal_events(journal, events)
+
+    def restore_from_journal(self, state):
+        """Rebuild the queues from a replayed JournalState: in-flight
+        tasks are requeued at the front (their worker may be mid-task,
+        riding out the outage — `report` accepts their result from the
+        queue), completed/failed counts and the epoch resume exactly,
+        and already-completed ids arm the duplicate-report dedup."""
+        with self._lock:
+            self._todo.clear()
+            self._doing.clear()
+            dropped_eval = 0
+            for rec in state.pending_tasks():
+                if rec["type"] == pb.EVALUATION:
+                    # EvaluationService state (the job's metric
+                    # accumulators, completion count) is NOT journaled
+                    # — declared out of recovery scope — so a restart
+                    # has no eval job to fold these into: completions
+                    # would be dropped or, worse, folded into the NEXT
+                    # version's job.  Drop them loudly; evaluation
+                    # re-arms cleanly at the next version report.
+                    dropped_eval += 1
+                    continue
+                shard = Shard(
+                    rec.get("name", ""), rec["start"], rec["end"],
+                    list(rec.get("idx") or []),
+                )
+                task = Task(
+                    rec["id"], shard, rec["type"], rec.get("mv", -1)
+                )
+                task.retry_count = state.retries.get(rec["id"], 0)
+                self._todo.append(task)
+            if dropped_eval:
+                logger.warning(
+                    "master restart: %d pending EVALUATION task(s) "
+                    "dropped (evaluation-service state is not "
+                    "recovered; the next version report re-creates "
+                    "the eval job)", dropped_eval,
+                )
+            self._task_id = max(self._task_id, state.max_task_id)
+            self._epoch = max(self._epoch, state.epoch)
+            for task_type, n in state.completed_counts.items():
+                self.completed_counts[task_type] = n
+            for task_type, n in state.failed_counts.items():
+                self.failed_counts[task_type] = n
+            self._train_end_callback_pending = (
+                self._train_end_callback_pending
+                or state.train_end_pending
+            )
+            self._train_end_callback_done = state.train_end_created
+            self._done_ids = set(state.done_ids)
+            restored = {
+                "todo": len(self._todo),
+                "completed": dict(self.completed_counts),
+                "failed": dict(self.failed_counts),
+                "epoch": self._epoch,
+                "next_task_id": self._task_id + 1,
+            }
+        logger.warning(
+            "master restart: task state restored from journal "
+            "(in-flight tasks requeued): %s", restored,
+        )
 
     def recover_tasks(self, worker_id):
         """Re-queue every task a dead worker was holding (elasticity path)."""

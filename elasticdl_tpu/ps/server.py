@@ -79,14 +79,26 @@ class ParameterServer:
                     self.args.ps_id, version)
 
     def prepare(self):
-        interceptors = None
+        interceptors = []
         if getattr(self.args, "rpc_delay_ms", 0) > 0:
             # Bench rigs run worker and PS on one host; this emulates
             # the cross-host wire latency the overlap path is built
             # to hide (see bench_ps_wire.py).
-            interceptors = [grpc_utils.RpcDelayInterceptor(
+            interceptors.append(grpc_utils.RpcDelayInterceptor(
                 self.args.rpc_delay_ms / 1000.0
-            )]
+            ))
+        if getattr(self.args, "rpc_fault_spec", ""):
+            # Deterministic fault drills (docs/master_recovery.md):
+            # script "every Nth push fails" / "shard dark for 5 s"
+            # reproducibly against the worker retry paths.
+            logger.warning(
+                "PS RPC fault injection armed: %s",
+                self.args.rpc_fault_spec,
+            )
+            interceptors.append(grpc_utils.FaultInjectionInterceptor(
+                self.args.rpc_fault_spec
+            ))
+        interceptors = interceptors or None
         self._server = grpc_utils.build_server(
             max_workers=64, interceptors=interceptors
         )
@@ -182,10 +194,23 @@ def main(argv=None):
     args = parse_ps_args(argv)
     master_client = None
     if args.master_addr:
+        from elasticdl_tpu.utils.retry import RetryPolicy
         from elasticdl_tpu.worker.master_client import MasterClient
 
         channel = grpc_utils.build_channel(args.master_addr)
-        master_client = MasterClient(channel, worker_id=-1)
+        # SHORT budget: report_version runs inline on the gradient-push
+        # path (after the update lock is released but before the push
+        # RPC returns) — a master mid-restart should be ridden out for
+        # a few seconds, never stall pushes for the full worker-side
+        # outage budget.  _report_version swallows the final failure.
+        master_client = MasterClient(
+            channel, worker_id=-1, addr=args.master_addr,
+            retry=RetryPolicy(
+                name="ps_master_rpc", max_attempts=4,
+                deadline_secs=5.0, base_delay_secs=0.2,
+                max_delay_secs=1.0,
+            ),
+        )
     ps = ParameterServer(args, master_client=master_client)
     ps.prepare()
     signal.signal(signal.SIGTERM, lambda *a: ps.stop(checkpoint=True))

@@ -346,7 +346,12 @@ class PserverServicer:
         return None
 
     def _report_version(self, v):
-        """Master-RPC half of _post_update_locked; call UNLOCKED."""
+        """Master-RPC half of _post_update_locked; call UNLOCKED.
+
+        Outage riding lives in the client's SHORT retry policy
+        (ps/server.py builds the MasterClient with a few-second
+        budget — this runs inline on the push path); a master gone
+        past that budget is logged and skipped, never fatal."""
         if v is None:
             return
         try:

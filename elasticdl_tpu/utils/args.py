@@ -116,6 +116,24 @@ def build_master_parser():
                         help="HTTP observability port on the master "
                              "(/healthz /status /metrics); 0 = any "
                              "free port, -1 (default) = disabled")
+    parser.add_argument("--journal_dir", default="",
+                        help="job-state journal directory "
+                             "(master/journal.py): task lifecycle, "
+                             "progress counts and rendezvous epochs "
+                             "are logged append-only + fsync'd; a "
+                             "master relaunched with the same "
+                             "--journal_dir replays it, requeues "
+                             "in-flight tasks and resumes the job "
+                             "exactly — workers ride the outage and "
+                             "reconnect without restarting (empty = "
+                             "no journal, master crash kills the job)")
+    parser.add_argument("--rpc_fault_spec", default="",
+                        help="deterministic RPC fault injection on "
+                             "the master service (drills/tests): "
+                             "'seed=N;method:every=7,code=unavailable;"
+                             "*:down=5~10' — per-method seeded "
+                             "error/delay/blackhole schedules, see "
+                             "docs/master_recovery.md (empty = off)")
     parser.add_argument("--volume", default="",
                         help="pod volume mounts, reference syntax: "
                              "'claim_name=c,mount_path=/p;"
@@ -179,6 +197,10 @@ def build_ps_parser():
                         help="benchmark aid: add fixed latency to every "
                              "RPC to emulate a cross-host link on a "
                              "single-host rig (0 = off)")
+    parser.add_argument("--rpc_fault_spec", default="",
+                        help="deterministic RPC fault injection on the "
+                             "PS service (same grammar as the master "
+                             "flag; docs/master_recovery.md)")
     return parser
 
 

@@ -12,10 +12,19 @@ import time
 from collections import deque
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 # Sentinel: the master said "no task NOW, job not finished" (see
 # fetch_task(return_wait=True)).
 WAIT = object()
+
+# Warn when this many locally-counted records sit unreported (master
+# outage outlasting the RPC retry budget): the counts are safe — they
+# re-flush on the next window/task boundary after reconnect — but the
+# operator should know progress reporting is dark.
+DEFERRED_HIGH_WATER_RECORDS = 10000
 
 
 class LocalTask:
@@ -49,6 +58,32 @@ class DataShardService:
 
     def stop(self):
         self._stopped.set()
+
+    def _send_batch_done(self, count):
+        """The progress RPC with outage protection: a failed send puts
+        the counts BACK in the deferred buffer (they re-flush at the
+        next window/task boundary after reconnect) instead of raising
+        and stranding locally-counted records.  The buffer is one
+        integer — bounded by construction — with a high-water warning
+        so a long outage is visible.  Returns True when sent."""
+        try:
+            self._mc.report_batch_done(count)
+            return True
+        except Exception as e:  # noqa: BLE001 — outage outlasted retry
+            with self._lock:
+                self._deferred_records += count
+                buffered = self._deferred_records
+            logger.warning(
+                "report_batch_done failed (%s); %d records re-buffered "
+                "for flush after reconnect", e, buffered,
+            )
+            if buffered >= DEFERRED_HIGH_WATER_RECORDS:
+                logger.warning(
+                    "deferred progress high water: %d records counted "
+                    "locally but unreported — master outage has "
+                    "outlasted the RPC retry budget", buffered,
+                )
+            return False
 
     def fetch_task(self, task_type=None, wait=True, return_wait=False):
         """Fetch the next task; blocks through WAIT tasks if wait=True.
@@ -108,7 +143,7 @@ class DataShardService:
             # fetch_task/report_batch_done for the RPC's duration.
             counters = dict(self.exec_counters) if done else None
         if flush:
-            self._mc.report_batch_done(flush)
+            self._send_batch_done(flush)
         for task_id in done:
             self._mc.report_task_result(task_id, exec_counters=counters)
 
@@ -120,7 +155,7 @@ class DataShardService:
         with self._lock:
             flush, self._deferred_records = self._deferred_records, 0
         if flush:
-            self._mc.report_batch_done(flush)
+            self._send_batch_done(flush)
 
     def report_task_failed(self, task, err_message, requeue=False):
         """``requeue``: hand the task back WITHOUT consuming one of its

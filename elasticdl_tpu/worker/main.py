@@ -37,8 +37,8 @@ def build_worker(args):
         else int(os.environ.get("WORKER_ID", 0))
     )
     channel = grpc_utils.build_channel(master_addr)
-    grpc_utils.wait_for_channel_ready(channel)
-    mc = MasterClient(channel, worker_id=worker_id)
+    grpc_utils.connect_to_master(channel, master_addr)
+    mc = MasterClient(channel, worker_id=worker_id, addr=master_addr)
 
     spec = load_model_spec(args.model_zoo,
                            model_params=args.model_params)

@@ -3,20 +3,72 @@
 Parity with elasticai_api/common/master_client.py:20-131: thin typed
 wrappers over the gRPC stub, constructed from env
 (``MASTER_ADDR``/``WORKER_ID``) or explicitly.
+
+Every RPC rides out a transiently-unavailable master through the
+shared retry policy (utils/retry.py): a master SIGKILLed mid-job and
+relaunched with ``--journal_dir`` comes back in seconds, and clients
+that would previously crash (killing the worker and burning a task
+retry) now reconnect and continue.  Replay safety is the server's job:
+task reports carry task ids the restarted master deduplicates against
+its journal, so a retried TASK report is idempotent, never
+double-counted.  Progress counters (``report_batch_done``) carry no
+dedup token — a retried count whose first attempt was processed can
+inflate the observability counters; task accounting stays exact
+(docs/master_recovery.md, "Known at-least-once edges").
 """
 
 import os
+import threading
+import time
+from collections import deque
 
 import numpy as np
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.proto.rpc import MasterStub
 from elasticdl_tpu.utils import grpc_utils, tensor_codec
+from elasticdl_tpu.utils.retry import master_rpc_policy
 
 
 class MasterClient:
-    def __init__(self, channel, worker_id=0, worker_host=None):
+    def __init__(self, channel, worker_id=0, worker_host=None,
+                 retry=None, addr=None):
+        """``retry``: a utils.retry.RetryPolicy; None installs the
+        default master outage-riding policy.  Callers on a latency
+        budget (the PS push path reports versions inline) pass a short
+        one; ``retry_policy.timing`` is settable afterwards so the
+        Worker can bind its reported Timing onto the counters.
+
+        ``addr``: the master address, when known.  It arms channel
+        REBUILD on retry: after the master is SIGKILLed, the live
+        channel's subchannel can wedge (stale connect backoff /
+        poisoned fd never reaching the restarted listener), so each
+        retry reconnects on a fresh channel — the first retry after
+        the master is back succeeds immediately."""
+        self._channel = channel
         self._stub = MasterStub(channel)
+        self._addr = addr
+        # One client is shared across threads (record-index fetcher,
+        # deferred report flush, the main task loop), so rebuilds are
+        # serialized and generation-counted: the first thread to notice
+        # the outage rebuilds, later threads adopt its fresh stub.
+        self._refresh_lock = threading.Lock()
+        self._gen = 0
+        # Retired channels are parked, NOT closed: channel.close()
+        # terminates every in-flight RPC on it with CANCELLED — a code
+        # the retry policy rightly refuses to retry — so another
+        # thread's concurrent call would crash in exactly the outage
+        # the retry machinery rides out.  Entries are (channel,
+        # retired_at) and a channel is only closed once it has been
+        # parked longer than _RETIRE_AGE_SECS — a count bound alone
+        # is not safe: one fast-failing retry loop can cycle the
+        # deque in seconds while a blackholed peer still holds
+        # another thread's RPC in flight on the oldest channel.
+        self._retired = deque()
+        self._last_rebuild = 0.0
+        self.retry_policy = retry if retry is not None else (
+            master_rpc_policy()
+        )
         self.worker_id = worker_id
         self.worker_host = worker_host or "worker-%d" % worker_id
 
@@ -25,14 +77,66 @@ class MasterClient:
         addr = os.environ["MASTER_ADDR"]
         worker_id = int(os.environ.get("WORKER_ID", 0))
         channel = grpc_utils.build_channel(addr)
-        grpc_utils.wait_for_channel_ready(channel)
-        return cls(channel, worker_id=worker_id)
+        grpc_utils.connect_to_master(channel, addr)
+        return cls(channel, worker_id=worker_id, addr=addr)
+
+    # A parked channel may only be closed after this long: older than
+    # any plausible in-flight RPC on it (the outage-riding deadline
+    # budget is 120 s by default).
+    _RETIRE_AGE_SECS = 150.0
+    # Floor between rebuilds: one wedged channel needs ONE fresh
+    # replacement, not one per backoff step of every retrying thread —
+    # without a floor, a single fast-failing retry loop mints channels
+    # faster than parked ones can age out.
+    _REBUILD_INTERVAL_SECS = 2.0
+
+    def _refresh_stub(self, method_name, state):
+        """Rebuild the channel (see ``addr`` in __init__) and return
+        the fresh stub method for the retry loop; None (no rebuild)
+        when the address is unknown.  ``state['gen']`` is the
+        generation this caller last saw: if another thread already
+        rebuilt past it, no second rebuild — adopt the fresh stub."""
+        if self._addr is None:
+            return None
+        with self._refresh_lock:
+            now = time.monotonic()
+            if (
+                state["gen"] == self._gen
+                and now - self._last_rebuild >= self._REBUILD_INTERVAL_SECS
+            ):
+                self._retired.append((self._channel, now))
+                while self._retired and (
+                    now - self._retired[0][1] > self._RETIRE_AGE_SECS
+                ):
+                    old, _ = self._retired.popleft()
+                    try:
+                        old.close()
+                    except Exception:  # noqa: BLE001 — already broken
+                        pass
+                self._channel = grpc_utils.build_channel(self._addr)
+                self._stub = MasterStub(self._channel)
+                self._gen += 1
+                self._last_rebuild = now
+            state["gen"] = self._gen
+            return getattr(self._stub, method_name)
+
+    def _call(self, rpc_fn, request, method_name, state):
+        return self.retry_policy.call(
+            rpc_fn, request, description=method_name,
+            refresh=lambda: self._refresh_stub(method_name, state),
+        )
 
     def get_task(self, task_type=None):
         req = pb.GetTaskRequest(worker_id=self.worker_id)
         if task_type is not None:
             req.task_type = task_type
-        return self._stub.get_task(req).task
+        # Snapshot the (stub, generation) pair coherently under the
+        # refresh lock — a racing rebuild can't hand this call a torn
+        # (old stub, new gen) pair — then RPC outside it.
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        return self._call(stub.get_task, req, "get_task", state).task
 
     def report_task_result(self, task_id, err_message="", exec_counters=None,
                            requeue=False):
@@ -41,29 +145,46 @@ class MasterClient:
         )
         for k, v in (exec_counters or {}).items():
             req.exec_counters[k] = int(v)
-        self._stub.report_task_result(req)
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        self._call(
+            stub.report_task_result, req, "report_task_result", state
+        )
 
     def report_batch_done(self, record_count):
-        self._stub.report_batch_done(
-            pb.ReportBatchDoneRequest(
-                worker_id=self.worker_id, record_count=record_count
-            )
+        req = pb.ReportBatchDoneRequest(
+            worker_id=self.worker_id, record_count=record_count
         )
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        self._call(stub.report_batch_done, req, "report_batch_done", state)
 
     def get_comm_rank(self):
-        return self._stub.get_comm_rank(
-            pb.GetCommRankRequest(worker_host=self.worker_host)
-        )
+        req = pb.GetCommRankRequest(worker_host=self.worker_host)
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        return self._call(stub.get_comm_rank, req, "get_comm_rank", state)
 
     def report_train_loop_status(self, status):
-        self._stub.report_train_loop_status(
-            pb.ReportTrainLoopStatusRequest(
-                worker_host=self.worker_host, status=status
-            )
+        req = pb.ReportTrainLoopStatusRequest(
+            worker_host=self.worker_host, status=status
+        )
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        self._call(
+            stub.report_train_loop_status, req,
+            "report_train_loop_status", state,
         )
 
-    def report_evaluation_metrics(self, model_outputs, labels):
-        req = pb.ReportEvaluationMetricsRequest(worker_id=self.worker_id)
+    def report_evaluation_metrics(self, model_outputs, labels,
+                                  model_version=-1):
+        req = pb.ReportEvaluationMetricsRequest(
+            worker_id=self.worker_id, model_version=model_version,
+        )
         if isinstance(model_outputs, dict):
             for name, arr in model_outputs.items():
                 tensor_codec.ndarray_to_pb(
@@ -74,12 +195,27 @@ class MasterClient:
                 np.asarray(model_outputs), out=req.model_outputs["output"]
             )
         tensor_codec.ndarray_to_pb(np.asarray(labels), out=req.labels)
-        self._stub.report_evaluation_metrics(req)
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        self._call(
+            stub.report_evaluation_metrics, req,
+            "report_evaluation_metrics", state,
+        )
 
     def report_version(self, version):
-        self._stub.report_version(pb.ReportVersionRequest(model_version=version))
+        req = pb.ReportVersionRequest(model_version=version)
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        self._call(stub.report_version, req, "report_version", state)
 
     def report_training_params(self, **kwargs):
-        self._stub.report_training_params(
-            pb.ReportTrainingParamsRequest(**kwargs)
+        req = pb.ReportTrainingParamsRequest(**kwargs)
+        with self._refresh_lock:
+            stub = self._stub
+            state = {"gen": self._gen}
+        self._call(
+            stub.report_training_params, req,
+            "report_training_params", state,
         )

@@ -34,7 +34,6 @@ embedding pulls while the current device step runs (composing with
 same way one stage earlier).
 """
 
-import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -45,6 +44,7 @@ import numpy as np
 
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
+from elasticdl_tpu.utils.retry import RetryPolicy
 from elasticdl_tpu.utils.timing import Timing
 from elasticdl_tpu.worker.collective_trainer import _pad_batch
 from elasticdl_tpu.worker.fused_driver import PreparedBatch, StagedWindow
@@ -93,6 +93,20 @@ class ParameterServerTrainer(Trainer):
             0, int(async_push_window)
         )
         self.timing = Timing(logger=logger)
+        # Shared bounded-retry policy (utils/retry.py) for the async
+        # push path: by the time an async push fails its minibatch was
+        # already reported done, so the ride-out must live HERE or the
+        # gradient is dropped.  Any RpcError is retried (the in-task
+        # retry is the last line of defense), budget = 6 attempts.
+        self._push_retry = RetryPolicy(
+            name="ps_push",
+            max_attempts=6,
+            deadline_secs=None,
+            base_delay_secs=0.1,
+            max_delay_secs=3.0,
+            retryable=lambda e: isinstance(e, grpc.RpcError),
+            timing=self.timing,
+        )
 
         # Single worker thread => pushes leave in submission order
         # (double-buffered, not reordered); created eagerly so the
@@ -204,27 +218,15 @@ class ParameterServerTrainer(Trainer):
                     np.asarray(emb_grads[table])[:n_uniq], uniq_ids
                 )
             # The blocking path leans on the worker's minibatch retry
-            # loop to ride out a relaunching PS shard; by the time an
-            # async push fails, its minibatch was already reported
-            # done, so the retry must live HERE or the gradient is
-            # dropped.  Same double-apply-on-lost-response risk as the
-            # worker-level retry — bounded, never silent.
-            for attempt in range(5):
-                try:
-                    return self._ps.push_gradients(
-                        named_grads, emb_push,
-                        version=version, learning_rate=learning_rate,
-                    )
-                except grpc.RpcError as e:
-                    logger.warning(
-                        "async push failed (attempt %d): %s",
-                        attempt + 1, e,
-                    )
-                    self.timing.bump("push_rpc_retry")
-                    time.sleep(min(0.1 * (2 ** attempt), 3.0))
-            return self._ps.push_gradients(
+            # loop to ride out a relaunching PS shard; the async path
+            # rides it out here via the shared policy (same
+            # double-apply-on-lost-response risk as the worker-level
+            # retry — bounded, never silent).
+            return self._push_retry.call(
+                self._ps.push_gradients,
                 named_grads, emb_push,
                 version=version, learning_rate=learning_rate,
+                description="async gradient push",
             )
 
         self._push_inflight.append(self._push_pool.submit(push))

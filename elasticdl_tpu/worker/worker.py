@@ -11,6 +11,7 @@ import time
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.retry import RetryPolicy
 from elasticdl_tpu.utils.timing import Timing
 from elasticdl_tpu.worker.data_shard_service import DataShardService
 from elasticdl_tpu.worker.task_data_service import TaskDataService
@@ -76,6 +77,25 @@ class Worker:
         )
         self._data_service = TaskDataService(data_reader, spec.feed)
         self.timing = Timing(logger=logger)
+        # One retry policy family (utils/retry.py): the minibatch loop
+        # below keeps its structure (the elastic branch re-rendezvouses
+        # instead of sleeping) but the backoff/budget bookkeeping and
+        # the rpc_retry/rpc_gaveup counters are shared with every other
+        # outage-riding client in the worker.
+        self._minibatch_backoff = RetryPolicy(
+            name="minibatch",
+            max_attempts=max_minibatch_retries,
+            deadline_secs=None,
+            base_delay_secs=0.1,
+            max_delay_secs=3.0,
+            timing=self.timing,
+        )
+        retry_policy = getattr(master_client, "retry_policy", None)
+        if retry_policy is not None and retry_policy.timing is None:
+            # The MasterClient is built before the Worker owns a
+            # Timing; bind it so master-RPC retries land in the same
+            # reported counters.
+            retry_policy.timing = self.timing
         self._steps = 0
         self._preempt_requested = False
         self.preempted = False
@@ -155,10 +175,11 @@ class Worker:
                     if not self._elastic.await_new_epoch():
                         self._elastic.init_world_if_needed(force=True)
                     continue
-                # Exponential backoff so the retry budget rides out
-                # transient outages (a PS shard relaunching takes
-                # seconds; 64 instant retries would burn out in <1s).
-                time.sleep(min(0.1 * (2 ** min(attempt, 5)), 3.0))
+                # Jittered exponential backoff (shared policy) so the
+                # retry budget rides out transient outages (a PS shard
+                # relaunching takes seconds; 64 instant retries would
+                # burn out in <1s).
+                self._minibatch_backoff.pause(min(attempt, 5))
         raise RuntimeError(
             "minibatch failed after %d retries" % self._max_minibatch_retries
         ) from err
@@ -289,7 +310,9 @@ class Worker:
                 outputs, labels = self._trainer.evaluate_minibatch(
                     features, labels
                 )
-                self._mc.report_evaluation_metrics(outputs, labels)
+                self._mc.report_evaluation_metrics(
+                    outputs, labels, model_version=task.model_version,
+                )
             self._shard_service.report_task_done(task)
         except Exception as e:  # noqa: BLE001
             self._shard_service.report_task_failed(task, str(e))

@@ -562,6 +562,55 @@ def test_el008_flags_uncalled_service_method():
                    for f in findings)
 
 
+def test_el008_sees_stub_aliased_through_local():
+    """The snapshot-under-lock idiom (master_client.py): the stub is
+    read into a LOCAL under the refresh lock and the bound method is
+    passed to the retry wrapper — the alias must keep its stub type so
+    the call still registers as this service method's caller (and its
+    request type still conformance-checks)."""
+    source = textwrap.dedent("""
+        import threading
+
+        from elasticdl_tpu.proto import elastic_pb2 as pb
+        from elasticdl_tpu.proto.rpc import MasterStub
+
+        SERVICES = {
+            "elasticdl_tpu.Master": {
+                "get_task": (pb.GetTaskRequest, pb.GetTaskResponse),
+            },
+        }
+
+        class MasterServicer:
+            def get_task(self, request, _context=None):
+                return request
+
+        class Client:
+            def __init__(self, channel):
+                self._refresh_lock = threading.Lock()
+                self._stub = MasterStub(channel)
+
+            def _call(self, rpc_fn, request):
+                return rpc_fn(request)
+
+            def get_task(self):
+                req = pb.GetTaskRequest(worker_id=3)
+                with self._refresh_lock:
+                    stub = self._stub
+                return self._call(stub.get_task, req)
+
+            def wrong_request(self):
+                req = pb.ReportVersionRequest(model_version=1)
+                with self._refresh_lock:
+                    stub = self._stub
+                return self._call(stub.get_task, req)
+    """)
+    findings = [f for f in check_source(source) if f.rule == "EL008"]
+    assert not any("get_task has no client stub caller" in f.message
+                   for f in findings)
+    assert any("registers request type GetTaskRequest" in f.message
+               and ".wrong_request" in f.symbol for f in findings)
+
+
 # -- tracer lock-order edges --------------------------------------------
 
 
@@ -701,13 +750,19 @@ def test_lock_graph_artifact_produced_and_acyclic():
     # the known cross-component edges are present (docs embed these)
     edges = {(e["src"], e["dst"]) for e in data["edges"]}
     assert (
-        "elasticdl_tpu.master.evaluation_service.EvaluationService._lock",
-        "elasticdl_tpu.master.task_manager.TaskManager._lock",
-    ) in edges
-    assert (
         "elasticdl_tpu.ps.servicer.PserverServicer._lock",
         "elasticdl_tpu.ps.parameters.Parameters._lock",
     ) in edges
+    # The EvaluationService -> TaskManager edge was ELIMINATED by the
+    # journal work: create_evaluation_tasks now journals task records
+    # (file I/O, EL006), so EvaluationService calls it outside its
+    # lock behind a _creating reservation.  Its absence IS the fix —
+    # if it reappears, a convoy (and a blocking-under-lock finding)
+    # came back with it.
+    assert (
+        "elasticdl_tpu.master.evaluation_service.EvaluationService._lock",
+        "elasticdl_tpu.master.task_manager.TaskManager._lock",
+    ) not in edges
 
 
 def test_parallel_jobs_match_serial_findings():

@@ -79,3 +79,38 @@ def test_probe_does_not_complete_eval_jobs():
         assert job is not None and job._completed_tasks == 0
     finally:
         master.stop()
+
+
+def test_completion_in_next_creation_window_buffers(monkeypatch):
+    """A completion landing inside job #2's creation window must be
+    buffered and folded into job #2 — not applied to the retired,
+    already-finished job #1, which would wedge job #2 one completion
+    short forever and block every later evaluation."""
+    from elasticdl_tpu.master.evaluation_service import EvaluationService
+
+    task_manager = TaskManager(
+        evaluation_shards=[("e", 0, 10)], records_per_task=10,
+    )
+    eval_service = EvaluationService(task_manager, lambda: {},
+                                     evaluation_steps=1)
+    assert eval_service.add_evaluation_task_if_needed(model_version=1)
+    eval_service.complete_task()  # the single task: job #1 finishes
+    assert eval_service._job is None  # retired, not left in place
+    assert [v for v, _ in eval_service.history] == [1]
+
+    real_create = task_manager.create_evaluation_tasks
+
+    def create_then_race(model_version):
+        total = real_create(model_version)
+        # a fast worker finishes a task before _job is assigned
+        eval_service.complete_task()
+        return total
+
+    monkeypatch.setattr(
+        task_manager, "create_evaluation_tasks", create_then_race
+    )
+    assert eval_service.add_evaluation_task_if_needed(model_version=2)
+    # the raced completion reached job #2 (one task => finished), so
+    # history gained exactly one entry and evaluation #3 is not wedged
+    assert [v for v, _ in eval_service.history] == [1, 2]
+    assert eval_service.add_evaluation_task_if_needed(model_version=3)

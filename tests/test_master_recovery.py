@@ -1,0 +1,684 @@
+"""Master crash-restart recovery: journal round-trips, replay-safe
+task accounting, rendezvous epoch monotonicity, the unified retry
+policy, and deterministic RPC fault injection
+(docs/master_recovery.md)."""
+
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import grpc
+import pytest
+
+from elasticdl_tpu.master.journal import (
+    JournalWriter,
+    journal_path,
+    replay_journal,
+)
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.servicer import (
+    MasterServicer,
+    create_master_service,
+)
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.utils.grpc_utils import (
+    FaultInjectionInterceptor,
+    FaultSpec,
+)
+from elasticdl_tpu.utils.retry import RetryPolicy
+from elasticdl_tpu.utils.timing import Timing
+from elasticdl_tpu.worker.data_shard_service import DataShardService
+from elasticdl_tpu.worker.master_client import MasterClient
+
+
+class FakeRpcError(grpc.RpcError):
+    def __init__(self, code=grpc.StatusCode.UNAVAILABLE):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+def make_tm(journal_dir=None, **kw):
+    defaults = dict(
+        training_shards=[("f", 0, 120)], records_per_task=30,
+        num_epochs=1,
+    )
+    defaults.update(kw)
+    tm = TaskManager(**defaults)
+    if journal_dir is not None:
+        tm.attach_journal(JournalWriter(journal_dir), bootstrap=True)
+    return tm
+
+
+def restart_tm(journal_dir, **kw):
+    """The master/main.py restart flow, in miniature."""
+    state = replay_journal(journal_dir)
+    assert state is not None
+    tm = make_tm(journal_dir=None, **kw)
+    tm.restore_from_journal(state)
+    writer = JournalWriter(journal_dir)
+    writer.append({"ev": "restart"})
+    tm.attach_journal(writer, bootstrap=False)
+    return tm, state
+
+
+# -- journal framing ---------------------------------------------------------
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    w.append({"ev": "meta", "job": {"num_epochs": 2}})
+    w.append({"ev": "task", "id": 1, "type": int(pb.TRAINING),
+              "name": "f", "start": 0, "end": 30, "mv": -1})
+    w.append({"ev": "dispatch", "id": 1, "w": 0})
+    w.append({"ev": "done", "id": 1})
+    w.append({"ev": "batch", "w": 0, "n": 30})
+    w.append({"ev": "version", "v": 7})
+    w.append({"ev": "rdzv", "n": 3, "hosts": ["h0"]})
+    w.close()
+    state = replay_journal(jdir)
+    assert state.meta == {"num_epochs": 2}
+    assert state.status[1] == "done"
+    assert state.completed_counts[int(pb.TRAINING)] == 1
+    assert state.worker_records[0] == 30
+    assert state.records_done == 30
+    assert state.model_version == 7
+    assert state.rendezvous_id == 3
+    assert state.max_task_id == 1
+
+
+def test_truncated_tail_dropped_loudly_not_crash(tmp_path):
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    w.append({"ev": "task", "id": 1, "type": int(pb.TRAINING),
+              "name": "f", "start": 0, "end": 30, "mv": -1})
+    w.append({"ev": "done", "id": 1})
+    w.close()
+    path = journal_path(jdir)
+    intact = os.path.getsize(path)
+    # Torn write: half a frame of garbage at the tail.
+    with open(path, "ab") as fh:
+        fh.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefpartial")
+    import logging as _logging
+
+    messages = []
+    handler = _logging.Handler()
+    handler.emit = lambda rec: messages.append(rec.getMessage())
+    journal_logger = _logging.getLogger("elasticdl_tpu.master.journal")
+    journal_logger.addHandler(handler)
+    try:
+        state = replay_journal(jdir)
+    finally:
+        journal_logger.removeHandler(handler)
+    assert state is not None and state.status[1] == "done"
+    assert any("truncated" in m for m in messages)  # dropped LOUDLY
+    # Reopening the writer truncates back to the last valid frame so
+    # appends never land after garbage.
+    w2 = JournalWriter(jdir)
+    assert os.path.getsize(path) == intact
+    w2.append({"ev": "fail", "id": 1, "perm": False, "retries": 1})
+    w2.close()
+    state2 = replay_journal(jdir)
+    assert state2.status[1] == "done"  # done is absorbing
+
+
+# -- task manager restart ----------------------------------------------------
+
+def test_restart_requeues_inflight_and_resumes_exactly(tmp_path):
+    jdir = str(tmp_path)
+    tm1 = make_tm(journal_dir=jdir)  # 4 tasks of 30
+    t_done = tm1.get(0)
+    t_inflight = tm1.get(1)
+    tm1.report(t_done.id, True)
+    tm1._journal.close()  # crash
+
+    tm2, state = restart_tm(jdir)
+    counts = tm2.counts()
+    assert counts["completed"][pb.TRAINING] == 1
+    assert counts["doing"] == 0
+    assert counts["todo"] == 3  # 2 untouched + the in-flight requeued
+    # The requeued in-flight task dispatches FIRST.
+    nxt = tm2.get(2)
+    assert nxt.id == t_inflight.id
+    # Drain the job: exactly 4 completions total, nothing lost/doubled.
+    tm2.report(nxt.id, True)
+    while True:
+        t = tm2.get(2)
+        if t is None:
+            break
+        tm2.report(t.id, True)
+    assert tm2.finished()
+    assert tm2.counts()["completed"][pb.TRAINING] == 4
+
+
+def test_rereport_of_journaled_task_is_idempotent(tmp_path):
+    jdir = str(tmp_path)
+    tm1 = make_tm(journal_dir=jdir)
+    t = tm1.get(0)
+    tm1.report(t.id, True)
+    tm1._journal.close()
+
+    tm2, _ = restart_tm(jdir)
+    before = tm2.counts()["completed"][pb.TRAINING]
+    # The worker's report RPC raced the crash; its retry lands here.
+    result = tm2.report(t.id, True)
+    assert result.ok
+    assert tm2.counts()["completed"][pb.TRAINING] == before
+
+
+def test_report_for_requeued_task_completes_from_todo(tmp_path):
+    jdir = str(tmp_path)
+    tm1 = make_tm(journal_dir=jdir)
+    t = tm1.get(0)  # in flight at crash time
+    tm1._journal.close()
+
+    tm2, _ = restart_tm(jdir)
+    assert tm2.counts()["todo"] == 4  # requeued
+    # The worker rode out the outage and reports the task done.
+    result = tm2.report(t.id, True)
+    assert result.ok
+    counts = tm2.counts()
+    assert counts["completed"][pb.TRAINING] == 1
+    assert counts["todo"] == 3  # never re-dispatched, no double work
+
+
+def test_skip_records_flow_through_journal(tmp_path):
+    jdir = str(tmp_path)
+    tm1 = make_tm(journal_dir=jdir)
+    tm1.skip_records(45)  # drops task 1 (30) + trims 15 off task 2
+    tm1._journal.close()
+    tm2, _ = restart_tm(jdir)
+    t = tm2.get(0)
+    assert t.shard.start == 45 and t.shard.end == 60
+    assert tm2.counts()["completed"][pb.TRAINING] == 1
+
+
+def test_task_retry_budget_survives_restart(tmp_path):
+    jdir = str(tmp_path)
+    tm1 = make_tm(journal_dir=jdir, max_task_retries=2,
+                  training_shards=[("f", 0, 30)])
+    t = tm1.get(0)
+    tm1.report(t.id, False, "boom")  # retry 1 journaled
+    tm1._journal.close()
+
+    tm2, _ = restart_tm(jdir, max_task_retries=2,
+                        training_shards=[("f", 0, 30)])
+    t = tm2.get(0)
+    tm2.report(t.id, False, "boom")  # retry 2
+    t = tm2.get(0)
+    result = tm2.report(t.id, False, "boom")  # budget exhausted
+    assert result.permanent_failure
+    assert tm2.counts()["failed"][pb.TRAINING] == 1
+
+
+# -- rendezvous --------------------------------------------------------------
+
+def test_rendezvous_epoch_monotonic_across_restart(tmp_path):
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    rs1 = RendezvousServer(grace_secs=0.0, journal=w)
+    rs1.add_worker("h0")
+    rank, size, epoch1, _ = rs1.get_comm_rank("h0")
+    assert (rank, size) == (0, 1) and epoch1 == 1
+    rs1.add_worker("h1")
+    _, _, epoch2, _ = rs1.get_comm_rank("h0")
+    assert epoch2 == 2
+    w.close()  # crash
+
+    state = replay_journal(jdir)
+    assert state.rendezvous_id == 2
+    w2 = JournalWriter(jdir)
+    rs2 = RendezvousServer(
+        grace_secs=0.0, journal=w2,
+        initial_epoch=state.rendezvous_id + 1,
+    )
+    # A reconnecting worker sees rank=-1 at an id strictly above any
+    # epoch it can hold -> it re-announces instead of assuming its old
+    # world is live.
+    rank, _, epoch, _ = rs2.get_comm_rank("h0")
+    assert rank == -1 and epoch >= epoch2 + 1
+    rs2.add_worker("h0")
+    rs2.add_worker("h1")
+    rank, size, epoch3, _ = rs2.get_comm_rank("h0")
+    assert (rank, size) == (0, 2)
+    assert epoch3 > epoch2  # strictly monotone across the crash
+    w2.close()
+    assert replay_journal(jdir).rendezvous_id == epoch3
+
+
+class _RendezvousMasterClient:
+    """Fake MasterClient driving a RendezvousServer directly (the two
+    RPCs the controller's world management uses)."""
+
+    def __init__(self, rs, host):
+        self.rs = rs
+        self.host = host
+
+    def get_comm_rank(self):
+        rank, size, rid, addr = self.rs.get_comm_rank(self.host)
+        return SimpleNamespace(
+            rank_id=rank, world_size=size, rendezvous_id=rid,
+            coordinator_addr=addr,
+        )
+
+    def report_train_loop_status(self, status):
+        if status == pb.LOOP_START:
+            self.rs.add_worker(self.host)
+        else:
+            self.rs.remove_worker(self.host)
+
+
+def test_controller_reannounces_at_unchanged_restart_epoch():
+    """The worst-case restart: the master re-arms at journaled+1,
+    which EQUALS the un-journaled epoch a surviving worker glimpsed
+    just before the crash.  The survivor sees rank=-1 at an UNCHANGED
+    id against an empty committed world — it must re-announce anyway
+    (id-change detection alone would leave both sides waiting
+    forever)."""
+    from elasticdl_tpu.api.controller import ElasticCollectiveController
+
+    rs1 = RendezvousServer(grace_secs=0.0)
+    mc = _RendezvousMasterClient(rs1, "h0")
+    ctrl = ElasticCollectiveController(mc, trainer=object(),
+                                       check_secs=0.0)
+    mc.report_train_loop_status(pb.LOOP_START)
+    assert ctrl.init_world_if_needed(force=True)
+    # epoch 2: glimpsed by the worker, but (simulated) never durable
+    rs1.add_worker("h1")
+    assert ctrl.init_world_if_needed(force=True)
+    glimpsed = ctrl._rendezvous.rendezvous_id
+    assert glimpsed == 2
+
+    # master crash + restart: journal held only epoch 1, re-armed at
+    # 1 + 1 == the glimpsed id, committed world empty
+    rs2 = RendezvousServer(grace_secs=0.0, initial_epoch=glimpsed)
+    mc.rs = rs2
+    # first check: rank=-1, id unchanged -> must still announce
+    assert not ctrl.init_world_if_needed(force=True)
+    assert "h0" in rs2._next_hosts
+    # next check commits the post-restart epoch, strictly above
+    assert ctrl.init_world_if_needed(force=True)
+    assert ctrl._rendezvous.rank == 0
+    assert ctrl._rendezvous.rendezvous_id > glimpsed
+
+
+def test_flusher_survives_transient_flush_failure(tmp_path, monkeypatch):
+    """One failed fdatasync (EIO, ENOSPC, cgroup stall) must not kill
+    the flusher thread or lose the buffered events: flush() rewinds
+    the partial write, re-queues the blob, and the flusher retries."""
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    real_fdatasync = os.fdatasync
+    fails = {"n": 1}
+
+    def flaky_fdatasync(fd):
+        if fails["n"]:
+            fails["n"] -= 1
+            raise OSError("injected EIO")
+        return real_fdatasync(fd)
+
+    monkeypatch.setattr(os, "fdatasync", flaky_fdatasync)
+    w.append({"ev": "task", "id": 0, "type": int(pb.TRAINING),
+              "name": "x", "start": 0, "end": 4, "mv": -1})
+    w.kick()
+    deadline = time.time() + 10
+    state = None
+    while time.time() < deadline:
+        state = replay_journal(jdir)
+        if state is not None and 0 in state.tasks:
+            break
+        time.sleep(0.2)
+    assert state is not None and 0 in state.tasks  # flusher retried
+    w.close()
+    assert replay_journal(jdir).status == {0: "todo"}  # no duplicates
+
+
+def test_replay_tolerates_task_record_after_its_lifecycle(tmp_path):
+    """Handlers journal outside their locks, so a stalled creator can
+    append its 'task' record AFTER another thread journaled the
+    dispatch and completion of that very task.  Replay must still
+    count the completion instead of silently re-queuing a finished
+    task (two-pass apply: creations first)."""
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    w.append({"ev": "dispatch", "id": 0, "w": 1})
+    w.append({"ev": "done", "id": 0})
+    w.append({"ev": "task", "id": 0, "type": int(pb.TRAINING),
+              "name": "x", "start": 0, "end": 10, "mv": -1})
+    w.append({"ev": "task", "id": 1, "type": int(pb.TRAINING),
+              "name": "x", "start": 10, "end": 20, "mv": -1})
+    w.close()
+    state = replay_journal(jdir)
+    assert state.status == {0: "done", 1: "todo"}
+    assert state.completed_counts[int(pb.TRAINING)] == 1
+    assert 0 in state.done_ids  # duplicate re-report still dedups
+    assert [t["id"] for t in state.pending_tasks()] == [1]
+
+
+def test_stale_version_eval_reports_dropped():
+    """A straggler completion/metrics report from a finished job
+    (tagged with its model_version) must not leak into the next job —
+    neither into its creation-window buffers nor into the live job."""
+    from elasticdl_tpu.master.evaluation_service import (
+        EvaluationService,
+    )
+
+    class _CountMetric:
+        def __init__(self):
+            self.n = 0
+
+        def update(self, outputs, labels):
+            self.n += 1
+
+        def result(self):
+            return float(self.n)
+
+    tm = TaskManager(
+        evaluation_shards=[("e", 0, 10)], records_per_task=10,
+    )
+    es = EvaluationService(
+        tm, lambda: {"n": _CountMetric()}, evaluation_steps=1,
+    )
+    assert es.add_evaluation_task_if_needed(model_version=1)
+    es.report_evaluation_metrics("o", "l", model_version=1)
+    es.complete_task(model_version=1)  # job v1 finishes, retires
+    assert es.history == [(1, {"n": 1.0})]
+
+    real_create = tm.create_evaluation_tasks
+
+    def create_then_straggle(model_version):
+        total = real_create(model_version)
+        # straggler v1 duplicates land inside v2's creation window...
+        es.report_evaluation_metrics("o", "l", model_version=1)
+        es.complete_task(model_version=1)
+        # ...alongside a legitimate v2 report racing the assignment
+        es.report_evaluation_metrics("o", "l", model_version=2)
+        return total
+
+    tm.create_evaluation_tasks = create_then_straggle
+    assert es.add_evaluation_task_if_needed(model_version=2)
+    tm.create_evaluation_tasks = real_create
+    # v1 stragglers dropped; the v2 metric was buffered and folded in
+    assert es._job is not None and es._job._completed_tasks == 0
+    es.complete_task(model_version=2)
+    assert es.history == [(1, {"n": 1.0}), (2, {"n": 1.0})]
+    # stale completion against a LIVE job is ignored too
+    assert es.add_evaluation_task_if_needed(model_version=3)
+    es.complete_task(model_version=2)
+    assert es._job is not None and es._job._completed_tasks == 0
+    es.complete_task(model_version=3)
+    assert [v for v, _ in es.history] == [1, 2, 3]
+
+
+# -- retry policy ------------------------------------------------------------
+
+def test_retry_policy_rides_transients_and_counts(tmp_path):
+    timing = Timing()
+    sleeps = []
+    policy = RetryPolicy(
+        name="t", deadline_secs=60.0, base_delay_secs=0.01,
+        timing=timing, sleep=sleeps.append,
+    )
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise FakeRpcError()
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert timing.counters()["rpc_retry"] == 2
+    assert "rpc_gaveup" not in timing.counters()
+    assert len(sleeps) == 2 and sleeps[1] > sleeps[0]
+
+
+def test_retry_policy_budget_exhaustion_and_nonretryable():
+    timing = Timing()
+    policy = RetryPolicy(
+        name="t2", max_attempts=3, deadline_secs=None,
+        base_delay_secs=0.0, timing=timing, sleep=lambda s: None,
+    )
+    with pytest.raises(grpc.RpcError):
+        policy.call(lambda: (_ for _ in ()).throw(FakeRpcError()))
+    assert timing.counters()["rpc_gaveup"] == 1
+    assert timing.counters()["rpc_retry"] == 2  # 3 attempts, 2 pauses
+
+    # Non-transient errors surface immediately, no retry burned.
+    with pytest.raises(ValueError):
+        policy.call(lambda: (_ for _ in ()).throw(ValueError("bad")))
+    assert timing.counters()["rpc_retry"] == 2
+
+
+def test_retry_policy_deterministic_jitter():
+    d1 = [RetryPolicy(name="x", deadline_secs=1).delay_secs(i)
+          for i in range(6)]
+    d2 = [RetryPolicy(name="x", deadline_secs=1).delay_secs(i)
+          for i in range(6)]
+    assert d1 == d2
+
+
+def test_wait_for_channel_ready_budget_still_raises():
+    channel = grpc_utils.build_channel("localhost:1")  # nothing there
+    start = time.monotonic()
+    with pytest.raises(grpc.FutureTimeoutError):
+        grpc_utils.wait_for_channel_ready(
+            channel, timeout=0.3, deadline_secs=0.9
+        )
+    assert 0.5 < time.monotonic() - start < 10.0
+    channel.close()
+
+
+# -- deferred-report outage riding ------------------------------------------
+
+class FlakyMasterClient:
+    """get_task feeds fixed shards; report_batch_done fails N times."""
+
+    def __init__(self, sizes, fail_times):
+        self._tasks = [
+            SimpleNamespace(
+                id=i + 1, type=pb.TRAINING,
+                shard=SimpleNamespace(name="s", start=0, end=size,
+                                      record_indices=[]),
+                model_version=-1,
+            )
+            for i, size in enumerate(sizes)
+        ]
+        self.fail_times = fail_times
+        self.batch_counts = []
+        self.results = []
+
+    def get_task(self, task_type=None):
+        if self._tasks:
+            return self._tasks.pop(0)
+        return SimpleNamespace(id=-1, type=pb.TRAINING, shard=None,
+                               model_version=-1)
+
+    def report_batch_done(self, count):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise FakeRpcError()
+        self.batch_counts.append(count)
+
+    def report_task_result(self, task_id, err_message="",
+                           exec_counters=None, requeue=False):
+        self.results.append((task_id, err_message))
+
+
+def test_failed_flush_rebuffers_and_reflushes_exactly_once():
+    mc = FlakyMasterClient([20], fail_times=2)
+    svc = DataShardService(mc, batch_size=5)
+    svc.fetch_task()
+    svc.report_batch_done(5, defer=True)
+    svc.flush_batch_done()          # fails -> 5 re-buffered, no raise
+    assert mc.batch_counts == []
+    svc.report_batch_done(5, defer=True)
+    svc.flush_batch_done()          # fails -> 10 buffered
+    assert svc._deferred_records == 10
+    svc.report_batch_done(5, defer=True)
+    svc.flush_batch_done()          # master back: one RPC, 15 records
+    assert mc.batch_counts == [15]
+    svc.report_batch_done(5, defer=True)  # drains the 20-record shard
+    assert mc.batch_counts == [15, 5]
+    assert mc.results and mc.results[0][0] == 1
+    assert sum(mc.batch_counts) == 20  # nothing lost, nothing doubled
+
+
+# -- fault injection ---------------------------------------------------------
+
+def test_fault_spec_same_seed_same_schedule():
+    text = ("seed=7;report_batch_done:every=3,code=unavailable;"
+            "*:prob=0.25,delay_ms=4")
+    a = FaultSpec(text).plan("/elasticdl_tpu.Master/report_batch_done", 60)
+    b = FaultSpec(text).plan("/elasticdl_tpu.Master/report_batch_done", 60)
+    assert a == b
+    # The prob clause actually fires sometimes and the schedule is a
+    # real mix (not all-on / all-off).
+    delayed = [i for i, (d, _) in enumerate(a) if d > 0]
+    assert 0 < len(delayed) < 60
+    # every=3 clause: abort codes exactly at call 3, 6, 9, ...
+    aborted = [i + 1 for i, (_, c) in enumerate(a) if c is not None]
+    assert aborted == [i for i in range(1, 61) if i % 3 == 0]
+    # A different seed moves the prob coins.
+    c = FaultSpec("seed=8;" + text.split(";", 1)[1]).plan(
+        "/elasticdl_tpu.Master/report_batch_done", 60
+    )
+    assert [x[0] for x in c] != [x[0] for x in a]
+
+
+def test_fault_spec_nth_window_and_blackhole():
+    spec = FaultSpec("get_task:nth=2,count=2,blackhole=0.25")
+    plan = spec.plan("/elasticdl_tpu.Master/get_task", 5)
+    assert [c for _, c in plan] == [
+        None, grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.UNAVAILABLE,
+        None, None,
+    ]
+    assert plan[1][0] == pytest.approx(0.25)
+    # Methods outside the pattern are untouched.
+    assert spec.plan("/elasticdl_tpu.Master/report_version", 3) == [
+        (0.0, None)
+    ] * 3
+
+
+def test_fault_spec_down_window_is_wall_clock():
+    spec = FaultSpec("*:down=5~10")
+    assert spec.decide("/m/x", elapsed_secs=4.9) == (0.0, None)
+    assert spec.decide("/m/x", elapsed_secs=5.0) == (
+        0.0, grpc.StatusCode.UNAVAILABLE
+    )
+    assert spec.decide("/m/x", elapsed_secs=10.0) == (0.0, None)
+
+
+def test_fault_injection_client_rides_every_nth_failure(tmp_path):
+    tm = make_tm()
+    servicer = MasterServicer(tm)
+    server, port = create_master_service(
+        servicer,
+        interceptors=[FaultInjectionInterceptor(
+            "report_batch_done:every=2,code=unavailable"
+        )],
+    )
+    try:
+        timing = Timing()
+        channel = grpc_utils.build_channel("localhost:%d" % port)
+        grpc_utils.wait_for_channel_ready(channel)
+        mc = MasterClient(
+            channel, worker_id=5,
+            retry=RetryPolicy(
+                name="test_rpc", deadline_secs=30.0,
+                base_delay_secs=0.01, timing=timing,
+            ),
+        )
+        for _ in range(4):
+            mc.report_batch_done(10)
+        # Server-side calls 2, 4, 6 were aborted; every client call
+        # still landed exactly once.
+        assert servicer.worker_record_counts[5] == 40
+        assert timing.counters()["rpc_retry"] == 3
+        assert "rpc_gaveup" not in timing.counters()
+    finally:
+        server.stop(grace=0)
+
+
+# -- end-to-end restart over real gRPC --------------------------------------
+
+def test_master_restart_with_outage_riding_client(tmp_path):
+    """The drill in miniature: a client mid-job rides a master restart
+    on the SAME port; the job finishes with exact accounting."""
+    jdir = str(tmp_path)
+    port = grpc_utils.find_free_port()
+    tm1 = make_tm(journal_dir=jdir)
+    server1, _ = create_master_service(
+        MasterServicer(tm1, journal=tm1._journal), port=port
+    )
+    channel = grpc_utils.build_channel("localhost:%d" % port)
+    grpc_utils.wait_for_channel_ready(channel)
+    timing = Timing()
+    mc = MasterClient(
+        channel, worker_id=0,
+        retry=RetryPolicy(name="e2e", deadline_secs=30.0,
+                          base_delay_secs=0.05, timing=timing),
+    )
+    t1 = mc.get_task()
+    mc.report_task_result(t1.id)
+    mc.report_batch_done(30)
+    t2 = mc.get_task()  # in flight across the crash
+
+    server1.stop(grace=0)  # SIGKILL stand-in
+    tm1._journal.close()
+
+    # The worker keeps reporting into the outage on another thread.
+    done = threading.Event()
+
+    def report_through_outage():
+        mc.report_batch_done(30)
+        mc.report_task_result(t2.id)
+        done.set()
+
+    reporter = threading.Thread(target=report_through_outage,
+                                daemon=True)
+    reporter.start()
+    time.sleep(0.3)  # let retries begin against the dead port
+
+    tm2, state = restart_tm(jdir)
+    servicer2 = MasterServicer(tm2)
+    servicer2.restore_from_journal(state)
+    server2, _ = create_master_service(servicer2, port=port)
+    try:
+        assert done.wait(timeout=20.0)
+        assert timing.counters().get("rpc_retry", 0) >= 1
+        # Finish the job through the restarted master.
+        while True:
+            t = mc.get_task()
+            if t.id < 0:
+                break
+            mc.report_task_result(t.id)
+        counts = tm2.counts()
+        assert counts["completed"][pb.TRAINING] == 4
+        assert counts["failed"][pb.TRAINING] == 0
+        assert tm2.finished()
+        # Progress counts rode the restart too.
+        assert servicer2.worker_record_counts[0] == 60
+    finally:
+        server2.stop(grace=0)
+        tm2._journal.close()
+
+
+def test_journal_meta_mismatch_refused(tmp_path):
+    from elasticdl_tpu.master.main import _check_journal_meta
+    from elasticdl_tpu.master.journal import JournalState
+
+    state = JournalState()
+    state.meta = {"num_epochs": 2, "records_per_task": 30}
+    with pytest.raises(RuntimeError):
+        _check_journal_meta(
+            state, {"num_epochs": 3, "records_per_task": 30}
+        )
+    _check_journal_meta(
+        state, {"num_epochs": 2, "records_per_task": 30}
+    )

@@ -75,6 +75,13 @@ _JOIN_NAME_HINTS = ("thread", "worker", "watcher", "proc", "pool",
                     "queue", "timer", "fetcher", "reaper")
 _WAIT_NAME_HINTS = ("event", "stopped", "done", "ready", "closed",
                     "exhausted", "proc", "barrier")
+# The job-state journal (master/journal.py) does file writes + fsync:
+# appends/flushes must sit OUTSIDE servicer/task-manager lock regions
+# (collect events under the lock, emit after release) — this entry is
+# what lets EL006 *prove* that, per the recovery design.
+_JOURNAL_TYPES = {"JournalWriter"}
+_JOURNAL_NAME_HINTS = ("journal",)
+_JOURNAL_METHODS = ("append", "flush", "kick", "close")
 
 
 def _receiver_name(node):
@@ -128,6 +135,18 @@ def classify_call(call, type_of=None):
     # tier 2
     if method in METHOD_BLOCKING_ANY:
         return METHOD_BLOCKING_ANY[method]
+
+    # tier 3 — job-state journal calls; checked before the generic
+    # gates so `journal.append` never reads as a list append.  `kick`
+    # is cheap (condition notify) but kept in the set: the discipline
+    # is that NO journal call runs under a component lock, so a
+    # refactor can't silently move real I/O back inside one.
+    if method in _JOURNAL_METHODS:
+        if ctor in _JOURNAL_TYPES or (
+                ctor is None and _hinted(name, _JOURNAL_NAME_HINTS)):
+            return "journal %s() (journal I/O discipline)" % method
+        if method == "append":
+            return None
 
     # tier 3 — receiver-kind gated
     if method == "result":

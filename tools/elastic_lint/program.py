@@ -265,6 +265,12 @@ class _FuncScanner(ast.NodeVisitor):
     def visit_Assign(self, node):
         self.visit(node.value)
         t = _value_type(node.value, self._pb, self._local_types)
+        if t is None:
+            # Aliasing an already-typed value (``stub = self._stub``):
+            # the snapshot-under-lock idiom reads a guarded attr into a
+            # local and calls through the local, so the local must
+            # carry the attr's type for EL008 to keep seeing the RPC.
+            t = self._type_of(node.value)
         for target in node.targets:
             if isinstance(target, ast.Name):
                 if t is not None:
@@ -371,6 +377,33 @@ class _FuncScanner(ast.NodeVisitor):
                 lockref = self._lockref(func.value)
                 if lockref is not None:
                     self._acquire(lockref, node.lineno)
+        # Retry-wrapped RPC invocations: policy.call(stub.m, req, ...)
+        # passes the bound stub method as a VALUE (utils/retry.py's
+        # outage-riding clients).  Still an RPC call site — recorded
+        # for EL008 conformance AND as an EL006 blocking op (it parks
+        # the thread like the direct call, deadline included), so the
+        # retry wrapper cannot launder an RPC-under-lock.
+        for i, arg in enumerate(node.args):
+            if not isinstance(arg, ast.Attribute):
+                continue
+            t = self._type_of(arg.value)
+            if t is None or t[0] != "stub":
+                continue
+            msg = None
+            if i + 1 < len(node.args):
+                mt = _value_type(
+                    node.args[i + 1], self._pb, self._local_types
+                )
+                if mt is not None and mt[0] == "msg":
+                    msg = mt[1]
+            self._mod.rpc_calls.append((
+                t[1], arg.attr, msg, node.lineno, self._f.qualname,
+                False,
+            ))
+            self._f.blocking.append((
+                "RPC %s() on %s (retry-wrapped)" % (arg.attr, t[1]),
+                node.lineno, tuple(self._held),
+            ))
         # pb message constructors
         dotted = _dotted_ctor(func)
         if dotted is not None and "." in dotted:
