@@ -31,6 +31,7 @@ _MASTER_ONLY_ARGS = (
     "worker_backend", "image", "namespace", "worker_resource_request",
     "tpu_topology", "worker_pod_priority", "cluster_spec", "volume",
     "status_port", "journal_dir", "rpc_fault_spec",
+    "ps_rpc_fault_spec",
 )
 
 # Job-config fields that must match between the journal and a
@@ -255,6 +256,9 @@ def build_master(args):
             use_async=args.use_async,
             grads_to_wait=args.grads_to_wait,
             sync_version_tolerance=args.sync_version_tolerance,
+            # Worker->PS drills: each shard arms this as its own
+            # --rpc_fault_spec (docs/ps_recovery.md).
+            ps_fault_spec=args.ps_rpc_fault_spec,
         )
     worker_manager = None
     if args.num_workers > 0:

@@ -41,6 +41,12 @@ class MasterServicer:
         self.training_params = None
         self.worker_record_counts = {}  # worker_id -> records processed
         self.worker_exec_counters = {}  # counter name -> total
+        # PS recovery state from generation-tagged version reports
+        # (docs/ps_recovery.md): ps_id -> {generation, version,
+        # durable_version}.  Observability only (status page, drills);
+        # not journaled — a restarted master re-learns it from the next
+        # cadence of reports.
+        self.ps_shard_state = {}
 
     def restore_from_journal(self, state):
         """Master restart: resume the version high-water mark and the
@@ -159,11 +165,69 @@ class MasterServicer:
             )
         return pb.Empty()
 
+    def ps_state(self):
+        """Copy-safe snapshot of per-shard PS recovery state for the
+        status page."""
+        with self._lock:
+            return {
+                ps_id: dict(s)
+                for ps_id, s in self.ps_shard_state.items()
+            }
+
+    def ps_commit_mark(self):
+        """Cross-shard min of the reported durable versions — an UPPER
+        BOUND on the committed checkpoint label a restore would come
+        back at.  Exact in the common case (every shard saves every
+        cadence label); it can overstate when a shard skipped a label
+        (``ps_ckpt_failed`` > 0 on any shard is the signal — the true
+        committed label may then be older than this mark, the disk is
+        authoritative) or before every shard has reported.  None until
+        a PS shard has reported.  The gap between ``model_version`` and
+        this mark is at least the state a crash right now would lose."""
+        with self._lock:
+            if not self.ps_shard_state:
+                return None
+            return min(
+                s["durable_version"]
+                for s in self.ps_shard_state.values()
+            )
+
     @rpc_error_guard
     def report_version(self, request, _context=None):
         with self._lock:
             advanced = request.model_version > self._version
             self._version = max(self._version, request.model_version)
+            if request.is_ps:
+                state = self.ps_shard_state.setdefault(
+                    request.ps_id,
+                    {"generation": 0, "version": 0,
+                     "durable_version": 0},
+                )
+                # A report from an OLDER incarnation (delayed by its
+                # client's outage-riding retry, landing after the
+                # relaunch already reported) must not touch the
+                # recovery state: its durable_version describes files
+                # the restore-time truncation may have deleted, and
+                # folding it in would float the commit mark above what
+                # is actually on disk.
+                if request.generation >= state["generation"]:
+                    if state["generation"] and (
+                        request.generation > state["generation"]
+                    ):
+                        logger.warning(
+                            "PS shard %d serving as generation %d "
+                            "(was %d): shard restarted",
+                            request.ps_id, request.generation,
+                            state["generation"],
+                        )
+                    state["generation"] = request.generation
+                    state["version"] = max(
+                        state["version"], request.model_version
+                    )
+                    # NOT max-folded: a relaunched shard that restored
+                    # an older committed version really is durable only
+                    # up to there — the mark must move back with it.
+                    state["durable_version"] = request.durable_version
         if advanced and self._journal is not None:
             self._journal.append(
                 {"ev": "version", "v": request.model_version}

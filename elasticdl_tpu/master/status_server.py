@@ -41,6 +41,15 @@ def collect_status(task_manager, worker_manager=None,
         }
     if servicer is not None:
         status["exec_counters"] = dict(servicer.worker_exec_counters)
+        ps_state = servicer.ps_state()
+        if ps_state:
+            # PS recovery plane (docs/ps_recovery.md): per-shard
+            # generation/durable version plus the cross-shard commit
+            # mark — the version a PS restore would come back at.
+            status["ps"] = {
+                "shards": ps_state,
+                "commit_mark": servicer.ps_commit_mark(),
+            }
     return status
 
 
@@ -78,6 +87,13 @@ def to_prometheus(status):
               len(status["rendezvous"]["world"]))
     for name, value in status.get("exec_counters", {}).items():
         gauge("elasticdl_worker_counter", value, name=name)
+    if "ps" in status:
+        gauge("elasticdl_ps_commit_mark", status["ps"]["commit_mark"])
+        for ps_id, shard in sorted(status["ps"]["shards"].items()):
+            gauge("elasticdl_ps_shard_generation",
+                  shard["generation"], ps_id=str(ps_id))
+            gauge("elasticdl_ps_shard_durable_version",
+                  shard["durable_version"], ps_id=str(ps_id))
     return "\n".join(lines) + "\n"
 
 

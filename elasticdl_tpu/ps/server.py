@@ -1,7 +1,14 @@
 """Parameter-server process (parity:
 elasticdl/python/ps/parameter_server.py:35-161,
-go/cmd/elasticdl_ps/main.go:27-74)."""
+go/cmd/elasticdl_ps/main.go:27-74).
 
+Every start establishes a monotone restart GENERATION (persisted beside
+the checkpoints, and/or hinted by the launcher's ``--generation``); the
+servicer stamps it on every data-plane response so workers detect a
+relaunch and reconcile instead of training against a silently
+rolled-back shard (docs/ps_recovery.md)."""
+
+import os
 import signal
 import threading
 
@@ -17,12 +24,57 @@ from elasticdl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def establish_generation(checkpoint_dir, ps_id, hint=0):
+    """Monotone restart generation for this shard, bumped on EVERY
+    start.  With a checkpoint dir the counter persists in
+    ``<dir>/generation-<ps_id>`` (written durably BEFORE the shard
+    serves, so no response can carry a generation a crash could
+    reissue); the launcher's ``hint`` (PSManager passes its per-shard
+    launch count) can only move it forward.  Without either there is
+    nothing to fence against and the generation is a constant 1 —
+    fencing needs a persisted counter or a counting launcher."""
+    persisted = 0
+    path = None
+    if checkpoint_dir:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "generation-%d" % ps_id)
+        try:
+            with open(path, "r") as f:
+                persisted = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            persisted = 0
+    generation = max(persisted + 1, int(hint or 0), 1)
+    if path is not None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("%d\n" % generation)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # fsync the DIRECTORY too: the rename itself must be durable
+        # before this generation stamps any response, or a power cut
+        # could resurrect the old counter and let a future start
+        # reissue this incarnation's generation.
+        dirfd = os.open(checkpoint_dir, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    return generation
+
+
 class ParameterServer:
     def __init__(self, args, master_client=None):
         self.args = args
         self._master_client = master_client
         self.parameters = Parameters()
         self.optimizer = create_optimizer(args.opt_type, args.opt_args)
+        self.generation = establish_generation(
+            args.checkpoint_dir or args.checkpoint_dir_for_init,
+            args.ps_id, hint=getattr(args, "generation", 0),
+        )
+        logger.info("PS shard %d starting as generation %d",
+                    args.ps_id, self.generation)
         saver = None
         if args.checkpoint_dir:
             saver = CheckpointSaver(
@@ -41,6 +93,7 @@ class ParameterServer:
             checkpoint_steps=args.checkpoint_steps,
             evaluation_steps=args.evaluation_steps,
             master_client=master_client,
+            generation=self.generation,
         )
         self._server = None
         self.port = None
@@ -49,16 +102,28 @@ class ParameterServer:
             self._restore(args.checkpoint_dir_for_init)
 
     def _restore(self, ckpt_dir):
-        """Restore this shard by re-hash-routing the stored version
-        (reference go/pkg/ps/checkpoint.go:98-133)."""
+        """Restore this shard from the newest COMMITTED (cross-shard
+        consistent) checkpoint version, re-hash-routing if the shard
+        count changed (reference go/pkg/ps/checkpoint.go:98-133;
+        barrier semantics: docs/ps_recovery.md).  A shard with no
+        committed checkpoint re-enters the uninitialized state and the
+        workers' push-to-init path re-seeds it mid-run."""
         saver = CheckpointSaver(ckpt_dir)
         try:
             dense, embeddings, version = saver.load_shard(
                 None, self.args.ps_id, self.args.num_ps
             )
-        except FileNotFoundError:
-            logger.warning("no checkpoint to restore in %s", ckpt_dir)
+        except FileNotFoundError as e:
+            logger.warning("no checkpoint to restore in %s (%s); "
+                           "awaiting worker push-to-init", ckpt_dir, e)
             return
+        # Rollback truncation: files this shard wrote AFTER the version
+        # being restored belong to the dead incarnation's abandoned
+        # timeline — left in place one could later complete a label into
+        # a fake "committed" set that mixes timelines.
+        saver.truncate_shard_after(
+            version, self.args.ps_id, self.args.num_ps
+        )
         slot_payload = {
             k[len("optslot/"):]: dense.pop(k)
             for k in [k for k in dense if k.startswith("optslot/")]
@@ -75,6 +140,7 @@ class ParameterServer:
             slot_names=self.optimizer.slot_names,
         )
         self.parameters.version = version
+        self.servicer.seed_durable_version(version)
         logger.info("restored PS shard %d from version %d",
                     self.args.ps_id, version)
 
@@ -120,6 +186,8 @@ class ParameterServer:
                     "ps_id": self.args.ps_id,
                     "num_ps": self.args.num_ps,
                     "version": self.parameters.version,
+                    "generation": self.generation,
+                    "durable_version": self.servicer.durable_version,
                     "initialized": self.parameters.initialized,
                     "counters": dict(self.servicer.counters),
                 }
@@ -128,6 +196,10 @@ class ParameterServer:
                 lines = [
                     prometheus_line("elasticdl_ps_version",
                                     status["version"]),
+                    prometheus_line("elasticdl_ps_generation",
+                                    status["generation"]),
+                    prometheus_line("elasticdl_ps_durable_version",
+                                    status["durable_version"]),
                     prometheus_line("elasticdl_ps_initialized",
                                     int(status["initialized"])),
                 ] + [

@@ -10,6 +10,18 @@ go/pkg/ps/server.go:54-253:
    ``sync_version_tolerance`` (worker re-pulls and retries the minibatch)
  - checkpoint every ``checkpoint_steps`` versions; report version to the
    master every ``evaluation_steps`` versions
+
+Restart-generation fencing (docs/ps_recovery.md): every response on the
+data plane carries this incarnation's ``generation`` (monotone across
+restarts, established by ps/server.py).  A push or prepare stamped with
+a DIFFERENT generation was computed against a dead incarnation's state
+and is rejected outright — in async mode the version check alone would
+happily mis-apply it to the restored (older-version) state as a
+"future-version" gradient.  A pull whose request carries a stale
+generation bypasses the ``request.version < version`` fast path, because
+after a crash-restore rollback that check points the wrong way.
+``generation`` is fixed for the life of the process, so fencing reads it
+without the update lock.
 """
 
 import threading
@@ -40,6 +52,7 @@ class PserverServicer:
         checkpoint_steps=0,
         evaluation_steps=0,
         master_client=None,
+        generation=1,
     ):
         self._params = parameters
         self._opt = optimizer
@@ -53,6 +66,13 @@ class PserverServicer:
         self._checkpoint_steps = checkpoint_steps
         self._evaluation_steps = evaluation_steps
         self._master_client = master_client
+        # Restart incarnation; IMMUTABLE for the life of the process
+        # (bumped by ps/server.py on every start), so fencing checks
+        # read it lock-free.
+        self.generation = max(1, int(generation))
+        # Last version verifiably on disk (this incarnation); guarded
+        # by self._lock like the checkpoint path that writes it.
+        self._durable_version = 0
         self._lock = threading.Lock()
         self._grad_buffer = []   # [(dense, embeddings)] awaiting sync apply
         self._staged = {}        # txn_id -> (dense, emb, lr, stage_time)
@@ -62,6 +82,7 @@ class PserverServicer:
         # deliberately lock-free — that one counter tolerates rare
         # lost increments rather than re-serializing the hot RPC.
         self.counters = {"push_accepted": 0, "push_rejected": 0,
+                         "push_gen_rejected": 0, "ps_ckpt_failed": 0,
                          "pull_dense": 0, "pull_embedding": 0}
 
     # -- RPCs ---------------------------------------------------------------
@@ -87,6 +108,16 @@ class PserverServicer:
     @rpc_error_guard
     def pull_dense_parameters(self, request, _context=None):
         res = pb.PullDenseParametersResponse()
+        res.generation = self.generation
+        # A client that last observed a different incarnation gets the
+        # full dense state regardless of its version: after a crash-
+        # restore rollback the server's version is BELOW the client's,
+        # so the fast-path comparison alone would starve it of the
+        # restored state forever (0 = client has no generation yet; the
+        # version check governs, as before fencing existed).
+        stale_gen = bool(request.generation) and (
+            request.generation != self.generation
+        )
         # Serialize against in-place kernel updates so pulls never see a
         # half-applied parameter buffer.
         with self._lock:
@@ -96,6 +127,7 @@ class PserverServicer:
             if self._params.initialized and (
                 request.version < self._params.version
                 or request.version < 0
+                or stale_gen
             ):
                 for name, arr in self._params.get_dense().items():
                     tensor_codec.ndarray_to_pb(
@@ -126,8 +158,32 @@ class PserverServicer:
             vectors, wire_dtype=request.wire_dtype or None
         )
 
+    def _fence(self, request_generation):
+        """Restart fencing: a push/prepare stamped by another incarnation
+        is rejected before any decode or apply.  ``self.generation`` is
+        immutable, so the check is lock-free; the lock is taken only to
+        bump the counter and read a coherent version for the response.
+        Returns the reject response, or None to proceed (0 = unstamped
+        legacy client: accept, the version checks govern)."""
+        if not request_generation or request_generation == self.generation:
+            return None
+        with self._lock:
+            self.counters["push_gen_rejected"] += 1
+            version = self._params.version
+        logger.warning(
+            "rejecting gradients stamped by generation %d (serving "
+            "generation %d): pushed by a dead incarnation's worker view",
+            request_generation, self.generation,
+        )
+        return pb.PushGradientsResponse(
+            accepted=False, version=version, generation=self.generation
+        )
+
     @rpc_error_guard
     def push_gradients(self, request, _context=None):
+        fenced = self._fence(request.generation)
+        if fenced is not None:
+            return fenced
         dense, embeddings, _, grad_version = tensor_codec.pb_to_model(
             request.gradients
         )
@@ -175,6 +231,7 @@ class PserverServicer:
                     res = pb.PushGradientsResponse(
                         accepted=True, version=version
                     )
+        res.generation = self.generation
         self._report_version(report)
         return res
 
@@ -184,7 +241,12 @@ class PserverServicer:
         check and stage the gradients.  Nothing is applied until commit,
         so a reject on any sibling shard can abort everywhere — no shard
         ever half-applies a minibatch (reference semantics were per-shard,
-        python/ps/servicer.py:168-238; this closes that gap)."""
+        python/ps/servicer.py:168-238; this closes that gap).  A prepare
+        stamped by a dead incarnation is fenced like a push, so the 2PC
+        aborts cleanly on EVERY shard when one shard died mid-protocol."""
+        fenced = self._fence(request.generation)
+        if fenced is not None:
+            return fenced
         dense, embeddings, _, grad_version = tensor_codec.pb_to_model(
             request.gradients
         )
@@ -200,13 +262,15 @@ class PserverServicer:
             ):
                 self.counters["push_rejected"] += 1
                 return pb.PushGradientsResponse(
-                    accepted=False, version=self._params.version
+                    accepted=False, version=self._params.version,
+                    generation=self.generation,
                 )
             self._staged[request.txn_id] = (
                 dense, embeddings, request.learning_rate or None, now
             )
             return pb.PushGradientsResponse(
-                accepted=True, version=self._params.version
+                accepted=True, version=self._params.version,
+                generation=self.generation,
             )
 
     @rpc_error_guard
@@ -245,10 +309,25 @@ class PserverServicer:
                 res = pb.PushGradientsResponse(
                     accepted=True, version=self._params.version
                 )
+        res.generation = self.generation
         self._report_version(report)
         return res
 
     # -- internals ----------------------------------------------------------
+
+    @property
+    def durable_version(self):
+        """Last version verifiably on disk for this shard (0 = none) —
+        the shard's contribution to the cross-shard commit mark."""
+        with self._lock:
+            return self._durable_version
+
+    def seed_durable_version(self, version):
+        """Restore-time seeding (ps/server.py _restore): the label this
+        incarnation restored from IS on disk, so the first version
+        report must not drag the master's commit mark to 0."""
+        with self._lock:
+            self._durable_version = max(self._durable_version, version)
 
     def _reduce_buffer_locked(self):
         """Average dense grads; concatenate sparse grads (summing happens
@@ -295,16 +374,21 @@ class PserverServicer:
         the update lock — the SIGTERM path (ps/server.py
         stop(checkpoint=True)) can land while a push_gradients apply is
         mid-flight, and a torn params/slots snapshot would restore a
-        state that never existed."""
+        state that never existed.  Returns True iff the save landed."""
         with self._lock:
-            self._checkpoint_locked()
+            return self._checkpoint_locked()
 
     def _checkpoint_locked(self):
         """Body of checkpoint_now; caller holds self._lock (the
         periodic path _post_update_locked already runs under it — the lock is
-        not reentrant)."""
+        not reentrant).  A failed save is surfaced, not just logged:
+        the ``ps_ckpt_failed`` counter bumps and ``_durable_version``
+        stays behind, so the version reports to the master keep
+        carrying the TRUE durable mark — operators (and the recovery
+        drill) can see that a restore would lose more than one
+        checkpoint cadence."""
         if self._checkpoint_saver is None:
-            return
+            return False
         v = self._params.version
         try:
             dense, embeddings = self._params.to_checkpoint_payload()
@@ -321,40 +405,52 @@ class PserverServicer:
         except OSError as e:
             # Sibling shards GC concurrently; a lost checkpoint must
             # never fail the worker's push RPC.
+            self.counters["ps_ckpt_failed"] += 1
             logger.warning("checkpoint at v%d failed: %s", v, e)
+            return False
+        self._durable_version = v
+        return True
 
     def _post_update_locked(self):
-        """Checkpoint if due; returns the version to report to the
-        master (or None).  The report itself is an RPC and must happen
-        OUTSIDE self._lock — holding the update lock across the
-        master's round trip would convoy every concurrent pull/push
-        behind it (EL006) — so callers release first, then pass the
-        returned version to ``_report_version``."""
+        """Checkpoint if due; returns the (version, durable_version)
+        pair to report to the master, or None.  The report itself is an
+        RPC and must happen OUTSIDE self._lock — holding the update
+        lock across the master's round trip would convoy every
+        concurrent pull/push behind it (EL006) — so callers release
+        first, then pass the returned pair to ``_report_version``.  A
+        checkpoint-cadence version always reports (not only the
+        evaluation cadence): that report is how the master's
+        report_version plane learns the durable commit mark
+        (docs/ps_recovery.md, coordinated checkpoints)."""
         v = self._params.version
-        if (
+        ckpt_due = (
             self._checkpoint_saver is not None
             and self._checkpoint_steps
             and v % self._checkpoint_steps == 0
-        ):
+        )
+        if ckpt_due:
             self._checkpoint_locked()
-        if (
-            self._master_client is not None
-            and self._evaluation_steps
-            and v % self._evaluation_steps == 0
-        ):
-            return v
+        report_due = (
+            self._evaluation_steps and v % self._evaluation_steps == 0
+        )
+        if self._master_client is not None and (ckpt_due or report_due):
+            return v, self._durable_version
         return None
 
-    def _report_version(self, v):
+    def _report_version(self, report):
         """Master-RPC half of _post_update_locked; call UNLOCKED.
 
         Outage riding lives in the client's SHORT retry policy
         (ps/server.py builds the MasterClient with a few-second
         budget — this runs inline on the push path); a master gone
         past that budget is logged and skipped, never fatal."""
-        if v is None:
+        if report is None:
             return
+        v, durable = report
         try:
-            self._master_client.report_version(v)
+            self._master_client.report_version(
+                v, ps_id=self._ps_id, generation=self.generation,
+                durable_version=durable,
+            )
         except Exception as e:  # noqa: BLE001 — master may be gone
             logger.warning("report_version failed: %s", e)

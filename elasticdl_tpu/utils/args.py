@@ -134,6 +134,13 @@ def build_master_parser():
                              "*:down=5~10' — per-method seeded "
                              "error/delay/blackhole schedules, see "
                              "docs/master_recovery.md (empty = off)")
+    parser.add_argument("--ps_rpc_fault_spec", default="",
+                        help="deterministic RPC fault injection on the "
+                             "launched PS shards (worker->PS "
+                             "direction): forwarded by PSManager as "
+                             "each shard's --rpc_fault_spec — same "
+                             "grammar as --rpc_fault_spec (empty = "
+                             "off)")
     parser.add_argument("--volume", default="",
                         help="pod volume mounts, reference syntax: "
                              "'claim_name=c,mount_path=/p;"
@@ -188,6 +195,12 @@ def build_ps_parser():
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--generation", type=int, default=0,
+                        help="restart-generation hint from the "
+                             "launcher (PSManager passes its per-shard "
+                             "launch count); the shard serves as "
+                             "max(persisted+1, hint, 1) — see "
+                             "docs/ps_recovery.md")
     parser.add_argument("--evaluation_steps", type=int, default=0)
     parser.add_argument("--status_port", type=int, default=-1,
                         help="HTTP observability port (/healthz "

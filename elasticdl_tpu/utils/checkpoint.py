@@ -12,18 +12,20 @@ id mod N) so any shard count can be re-read by any other shard count.
 Payload per shard is a numpy .npz (named dense arrays + per-table id/value
 pairs), not protobuf — zero-copy friendly on the JAX side.
 
-With num_ps > 1 the shards' version counters drift (pushes can skip a
-shard; sync rejections are per-shard), so requiring all N files under one
-version dir could leave zero restorable checkpoints.  Restore therefore
-falls back to *per-shard* validity: a shard restarting with an unchanged
-shard count loads its own newest ``variables-i-of-N.ckpt`` even if the
-sibling shards checkpointed under different version labels.  That matches
-async-PS semantics — shard versions are independent counters and a
-globally consistent cut never exists in the first place.  Only a shard-
-count *change* requires a fully-valid version (all N files, so rows can be
-re-hash-routed).  GC is likewise per-shard: each shard prunes its own old
-files and removes version dirs it leaves empty, so drifting labels can't
-accumulate torn dirs forever.
+With num_ps > 1 the shards reach a checkpoint label at different times
+(pushes can skip a shard; sync rejections are per-shard), but every
+shard's version counter advances by exactly one per applied update, so
+all shards pass through the SAME label sequence (the multiples of
+``checkpoint_steps``) — the version-aligned checkpoint barrier of
+docs/ps_recovery.md.  A label *commits* once all N shard files exist
+under it.  Restore (``load_shard(version=None)``) loads only committed
+labels, one consistent version for every shard — it REFUSES a
+mixed-version shard set loudly rather than silently restoring shard i
+at one version and shard j at another (the pre-coordination behavior,
+which handed a one-shard relaunch a mixed-version dense model).  GC is
+per-shard: each shard prunes its own old files and removes version dirs
+it leaves empty, so drifting labels can't accumulate torn dirs forever
+(committed labels are protected, see ``_gc_shard``).
 
 Dense optimizer slot state is stored under ``optslot/<param>@<slot>`` (plus
 ``optslot/__step__``); on cross-shard re-routing a slot follows its parent
@@ -126,17 +128,14 @@ class CheckpointSaver:
         return versions[-1] if versions else None
 
     def latest_resumable_version(self, num_shards):
-        """Newest version any shard of an (unchanged) num_shards layout
-        could restore from — the max over fully-valid versions and every
-        shard's own per-shard versions.  The master uses this for its
+        """Newest version the PS fleet can restore — the committed
+        (fully-valid) checkpoint mark.  The master uses this for its
         skip-records resume math so it agrees with what the PS shards
-        will actually restore via ``load_shard(None, ...)``."""
-        candidates = [v for v in (self.latest_version(),) if v is not None]
-        for i in range(num_shards):
-            own = self.shard_versions(i, num_shards)
-            if own:
-                candidates.append(own[-1])
-        return max(candidates) if candidates else None
+        will actually restore via ``load_shard(None, ...)``: restore is
+        coordinated (one consistent label for every shard), so a lone
+        shard's newer uncommitted file no longer counts."""
+        del num_shards  # a committed label restores under any layout
+        return self.latest_version()
 
     def is_valid_version(self, version):
         """A version is valid iff, for some layout N, all N of its
@@ -244,25 +243,30 @@ class CheckpointSaver:
     def load_shard(self, version, shard_index, num_shards):
         """Load shard_index's slice of a stored version.
 
-        With ``version=None``, pick whichever is newer by version label:
-        the newest fully-valid version (re-hash-routable across any shard
-        count) or this shard's own newest file under the unchanged (i, N)
-        layout — so a fully-valid label from early in the job can never
-        silently roll a shard back past its own later checkpoints.
+        With ``version=None``, restore the newest COMMITTED label — the
+        newest version with a complete shard set, so every shard of the
+        fleet restores the same consistent version (one-shard relaunch
+        or full-fleet restart alike).  A directory holding only
+        uncommitted per-shard files (drifted labels, no label complete)
+        is REFUSED loudly: silently restoring this shard's own newest
+        file would hand the job a mixed-version dense model the workers
+        cannot detect (docs/ps_recovery.md, checkpoint barrier).
         """
         if version is None:
-            own = self.shard_versions(shard_index, num_shards)
             full = self.latest_version()
-            if not own and full is None:
+            if full is None:
+                own = self.shard_versions(shard_index, num_shards)
+                if own:
+                    raise FileNotFoundError(
+                        "no committed checkpoint in %s: shard %d/%d has "
+                        "only uncommitted per-shard files (labels %r) "
+                        "with no label complete across the shard set — "
+                        "refusing a mixed-version restore"
+                        % (self._dir, shard_index, num_shards, own)
+                    )
                 raise FileNotFoundError(
                     "no valid checkpoint in %s" % self._dir
                 )
-            if own and (full is None or own[-1] > full):
-                v = own[-1]
-                dense, embeddings = self._read_shard_file(
-                    _shard_file(self._dir, v, shard_index, num_shards)
-                )
-                return dense, embeddings, v
         dense, embeddings, version = self.load(version)
         my_dense = {
             k: v for k, v in dense.items()
@@ -273,6 +277,38 @@ class CheckpointSaver:
             sel = ids % num_shards == shard_index
             my_emb[name] = (ids[sel], values[sel])
         return my_dense, my_emb, version
+
+    def truncate_shard_after(self, version, shard_index, num_shards):
+        """Remove this shard's files with labels NEWER than ``version``
+        — the rollback half of a restore.  A shard restored at the
+        committed mark abandons the timeline its dead incarnation was
+        on; its newer files belong to that abandoned timeline, and left
+        in place one of them could later pair up with a sibling's
+        post-restore file under the same label into a fake "committed"
+        set that mixes timelines.  Only THIS shard's files go (siblings
+        that never died keep their continuous history); dirs left empty
+        are removed.  Returns the labels truncated."""
+        victims = [
+            v for v in self.shard_versions(shard_index, num_shards)
+            if v > version
+        ]
+        for v in victims:
+            try:
+                os.remove(_shard_file(self._dir, v, shard_index,
+                                      num_shards))
+            except OSError:
+                continue
+            try:
+                os.rmdir(_version_dir(self._dir, v))
+            except OSError:
+                pass  # other shards' files still present
+        if victims:
+            logger.warning(
+                "restore rollback: shard %d truncated abandoned-timeline "
+                "checkpoints %r (restored at version %d)",
+                shard_index, victims, version,
+            )
+        return victims
 
     @staticmethod
     def _dense_shard(key, num_shards):

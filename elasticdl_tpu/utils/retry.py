@@ -194,3 +194,25 @@ def master_rpc_policy(timing=None, deadline_secs=None):
         max_delay_secs=3.0,
         timing=timing,
     )
+
+
+def ps_rpc_policy(timing=None, deadline_secs=None):
+    """The outage-riding policy for worker->PS RPCs: a SIGKILLed PS
+    shard is relaunched-with-restore by the master's PSManager in
+    seconds, and every pull/push/prefetch must ride that window on the
+    SAME port instead of killing the worker (docs/ps_recovery.md).
+    Budgeted by the same ``ELASTICDL_RPC_DEADLINE_SECS`` env the master
+    policy uses, so drills shorten both outage budgets at once."""
+    import os
+
+    if deadline_secs is None:
+        deadline_secs = float(
+            os.environ.get("ELASTICDL_RPC_DEADLINE_SECS", "120")
+        )
+    return RetryPolicy(
+        name="ps_rpc",
+        deadline_secs=deadline_secs,
+        base_delay_secs=0.2,
+        max_delay_secs=3.0,
+        timing=timing,
+    )

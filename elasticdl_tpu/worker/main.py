@@ -67,6 +67,7 @@ def build_worker(args):
             args.prediction_outputs
         )
     if args.distribution_strategy == "ps":
+        from elasticdl_tpu.utils.retry import ps_rpc_policy
         from elasticdl_tpu.worker.ps_client import build_ps_client
         from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
 
@@ -78,6 +79,11 @@ def build_worker(args):
             dedicated_push_channels=(
                 args.use_async and args.async_push_window > 0
             ),
+            # Outage riding (docs/ps_recovery.md): a shard SIGKILLed
+            # and relaunched by PSManager on the same port is ridden
+            # through per-shard retries with channel rebuild instead of
+            # killing this worker.
+            retry=ps_rpc_policy(),
         )
         trainer = ParameterServerTrainer(
             spec, ps_client,

@@ -203,8 +203,19 @@ class MasterClient:
             "report_evaluation_metrics", state,
         )
 
-    def report_version(self, version):
+    def report_version(self, version, ps_id=None, generation=0,
+                       durable_version=0):
+        """``ps_id`` (+ generation/durable_version) marks this as a PS
+        shard's report: the master tracks per-shard recovery state and
+        derives the coordinated-checkpoint commit mark from the
+        cross-shard min of ``durable_version`` (docs/ps_recovery.md).
+        Workers report plain versions and leave the PS fields unset."""
         req = pb.ReportVersionRequest(model_version=version)
+        if ps_id is not None:
+            req.is_ps = True
+            req.ps_id = int(ps_id)
+            req.generation = int(generation)
+            req.durable_version = int(durable_version)
         with self._refresh_lock:
             stub = self._stub
             state = {"gen": self._gen}

@@ -32,6 +32,15 @@ point there, so nothing may ride ahead of it.  Independently,
 embedding pulls while the current device step runs (composing with
 ``data/parallel_reader.prefetch_batches``, which overlaps read/decode the
 same way one stage earlier).
+
+Crash-restart recovery (docs/ps_recovery.md): the PSClient tracks each
+shard's restart generation; when it moves, ``_maybe_reconcile`` drops
+the in-flight pipelined pushes (the restarted shard fences them — they
+were stamped by the dead incarnation), invalidates prefetched embedding
+rows, and re-pulls dense state unconditionally past the local-version
+fast path (a crash-restore rollback leaves the server's version BELOW
+ours).  A shard relaunched with no restorable checkpoint serves
+uninitialized and is re-seeded mid-run via the push-to-init path.
 """
 
 from collections import deque
@@ -107,6 +116,12 @@ class ParameterServerTrainer(Trainer):
             retryable=lambda e: isinstance(e, grpc.RpcError),
             timing=self.timing,
         )
+        # The PSClient is built before this trainer owns a Timing; bind
+        # it so its outage-riding retry counters (rpc_retry/rpc_gaveup)
+        # land in the same reported set.
+        ps_retry = getattr(ps_client, "retry_policy", None)
+        if ps_retry is not None and ps_retry.timing is None:
+            ps_retry.timing = self.timing
 
         # Single worker thread => pushes leave in submission order
         # (double-buffered, not reordered); created eagerly so the
@@ -137,8 +152,110 @@ class ParameterServerTrainer(Trainer):
         self._example_serving_input = None
         self._eval_step = None
         self._push_model_to_init()
+        # PS restart detection (docs/ps_recovery.md): the client bumps
+        # generation_epoch whenever a shard's restart generation
+        # changes; seeing it move, this trainer reconciles — drops
+        # in-flight pipelined pushes (the shard fences them anyway),
+        # invalidates prefetched embeddings, and re-pulls dense state
+        # past the local-version fast path.
+        self._seen_gen_epoch = getattr(ps_client, "generation_epoch", 0)
 
     # -- PS interaction -----------------------------------------------------
+
+    def _maybe_reconcile(self):
+        """PS restart reconciliation (docs/ps_recovery.md): if the
+        client observed a shard generation change since we last looked,
+        (1) wait out the in-flight pipelined pushes WITHOUT surfacing
+        their rejects — they are stamped with the dead incarnation's
+        generation, so the restarted shard fences them; re-pushing them
+        would apply gradients computed against abandoned state — (2)
+        drop prefetched embedding rows (they predate the restore), and
+        (3) re-pull dense state unconditionally (version=-1): the
+        restored version is usually BELOW ours, so the normal
+        ``request.version < server.version`` fast path would return
+        nothing and leave us training on the dead incarnation's params
+        forever.  Returns True iff a reconcile ran."""
+        epoch = getattr(self._ps, "generation_epoch", 0)
+        if epoch == self._seen_gen_epoch:
+            return False
+        dropped = 0
+        while self._push_inflight:
+            future = self._push_inflight.popleft()
+            try:
+                accepted, _ = future.result()
+            except Exception as e:  # noqa: BLE001 — dropping anyway
+                logger.warning("in-flight push failed during PS "
+                               "restart reconcile: %s", e)
+                accepted = False
+            if not accepted:
+                dropped += 1
+        self._prefetched.clear()
+        initialized, version, dense = self._ps.pull_dense_parameters(-1)
+        if not initialized:
+            # The shard came back with no restorable checkpoint:
+            # re-seed it from the local model mid-run (the race-safe
+            # push-to-init path) instead of wedging every pull.
+            self._push_model_to_init()
+        else:
+            if dense:
+                self._merge_dense(dense)
+            self._version = version
+            self._sync_gen_snapshot()
+        # Re-read AFTER the pull: generations the forced pull itself
+        # noted were answered by that same full response.
+        self._seen_gen_epoch = getattr(self._ps, "generation_epoch", 0)
+        self.timing.bump("ps_reconcile")
+        logger.warning(
+            "reconciled PS restart: %d in-flight pushes dropped, "
+            "prefetch cache invalidated, dense state re-pulled at "
+            "version %d", dropped, self._version,
+        )
+        return True
+
+    def _recover_embedding_failure(self, err):
+        """An embedding pull failed terminally (the client's retry
+        policy already rode out what it could).  The dense plane
+        carries the diagnosis the embedding plane can't: a shard
+        relaunched WITHOUT a restorable checkpoint serves uninitialized
+        (its tables are gone, so embedding pulls fail with INTERNAL
+        while steps-%-cadence never reaches a dense pull to notice) —
+        probe it, re-seed via push-to-init / reconcile as needed, and
+        surface the minibatch as rejected so the worker's retry loop
+        re-runs it against the recovered shard."""
+        if self._maybe_reconcile():
+            raise GradientsRejected(
+                "PS restarted mid-minibatch; reconciled — retry"
+            ) from err
+        # Epoch unchanged: probe for an uninitialized relaunch (the
+        # probe itself notes generations from the responses).
+        initialized, _, _ = self._ps.pull_dense_parameters(-1)
+        if not initialized:
+            logger.warning(
+                "embedding pull failed against an uninitialized PS "
+                "(relaunch without checkpoint?); re-seeding: %s", err,
+            )
+            self._push_model_to_init()
+            self._maybe_reconcile()
+            raise GradientsRejected(
+                "PS re-seeded after relaunch-without-checkpoint — retry"
+            ) from err
+        if self._maybe_reconcile():
+            raise GradientsRejected(
+                "PS restarted mid-minibatch; reconciled — retry"
+            ) from err
+        raise err  # healthy shards: a genuine failure, surface it
+
+    def _sync_gen_snapshot(self):
+        """Freeze the per-shard generations the local params were last
+        synchronized under.  Every push is stamped with THIS snapshot,
+        not whatever the client knows at push-execution time: between a
+        pull and a deferred push's execution, a concurrent thread (the
+        push executor collecting an earlier fenced reject) can teach
+        the client a restarted shard's NEW generation — and a
+        then-current stamp would slip a gradient computed against the
+        dead incarnation's state past the restart fence."""
+        snap = getattr(self._ps, "generation_snapshot", None)
+        self._gen_snapshot = snap() if snap is not None else None
 
     def _push_model_to_init(self):
         """First contact: initialize the PS shards from the local init
@@ -153,6 +270,7 @@ class ParameterServerTrainer(Trainer):
         if dense:
             self._merge_dense(dense)
         self._version = version
+        self._sync_gen_snapshot()
 
     def _pull_dense(self):
         with self.timing.timeit("get_model"):
@@ -171,6 +289,24 @@ class ParameterServerTrainer(Trainer):
             if dense:
                 self._merge_dense(dense)
             self._version = version
+            self._sync_gen_snapshot()
+        # If this very pull discovered a shard restart, the response
+        # was already the full restored state (the request still
+        # carried the OLD generation, so the server bypassed its
+        # version fast path) — the dense half of the reconcile is
+        # done.  Only the prefetched rows, which predate the restore,
+        # still need dropping (in-flight pushes drained before any
+        # cadence pull).
+        epoch = getattr(self._ps, "generation_epoch", 0)
+        if epoch != self._seen_gen_epoch:
+            self._prefetched.clear()
+            self._seen_gen_epoch = epoch
+            self.timing.bump("ps_reconcile")
+            logger.warning(
+                "reconciled PS restart at cadence pull: prefetch "
+                "cache invalidated, dense state restored at version "
+                "%d", self._version,
+            )
 
     def _merge_dense(self, dense):
         """Merge a (possibly partial) dense pull into local params — a
@@ -209,6 +345,11 @@ class ParameterServerTrainer(Trainer):
             self._drain_oldest_push()
         version = self._version
         learning_rate = self._learning_rate
+        # Stamp with the generations the local params were last SYNCED
+        # under (_sync_gen_snapshot) — not submit-time, and certainly
+        # not execution-time: either later read could already reflect a
+        # restart this minibatch's gradients predate.
+        generations = self._gen_snapshot
 
         def push():
             named_grads, _ = flatten_with_names(to_numpy(param_grads))
@@ -219,13 +360,22 @@ class ParameterServerTrainer(Trainer):
                 )
             # The blocking path leans on the worker's minibatch retry
             # loop to ride out a relaunching PS shard; the async path
-            # rides it out here via the shared policy (same
-            # double-apply-on-lost-response risk as the worker-level
-            # retry — bounded, never silent).
+            # rides it out here.  A retry-armed client (ps_rpc_policy)
+            # carries its own full outage budget per call — wrapping it
+            # in _push_retry would MULTIPLY the budgets (6 x 120 s
+            # against a permanently dead shard), so the wrapper applies
+            # only to the legacy fail-fast client.
+            if getattr(self._ps, "retry_policy", None) is not None:
+                return self._ps.push_gradients(
+                    named_grads, emb_push,
+                    version=version, learning_rate=learning_rate,
+                    generations=generations,
+                )
             return self._push_retry.call(
                 self._ps.push_gradients,
                 named_grads, emb_push,
                 version=version, learning_rate=learning_rate,
+                generations=generations,
                 description="async gradient push",
             )
 
@@ -240,9 +390,13 @@ class ParameterServerTrainer(Trainer):
             # Empty the pipeline before surfacing the reject: the
             # worker's retry loop must restart from a known-clean state
             # (pending pushes against the stale version would only be
-            # rejected too).
+            # rejected too).  A generation-fenced reject (the shard
+            # restarted under us) reconciles instead — the forced full
+            # pull there bypasses the fast path the rolled-back server
+            # would otherwise starve us through.
             self.drain_pushes()
-            self._pull_dense()
+            if not self._maybe_reconcile():
+                self._pull_dense()
             raise GradientsRejected(
                 "stale gradients at version %d" % self._version
             )
@@ -333,15 +487,22 @@ class ParameterServerTrainer(Trainer):
                 (table, uniq.tobytes()), None
             )
             with self.timing.timeit("pull_embedding"):
-                if prefetched is not None:
-                    rows = prefetched.result()
-                    self.timing.bump("prefetch_hit")
-                else:
-                    rows = self._ps.pull_embedding_vectors(
-                        table, uniq, dim=self._emb_dims[table]
-                    )
-                    if self._prefetch_active:
-                        self.timing.bump("prefetch_miss")
+                try:
+                    if prefetched is not None:
+                        rows = prefetched.result()
+                        self.timing.bump("prefetch_hit")
+                    else:
+                        rows = self._ps.pull_embedding_vectors(
+                            table, uniq, dim=self._emb_dims[table]
+                        )
+                        if self._prefetch_active:
+                            self.timing.bump("prefetch_miss")
+                except grpc.RpcError as err:
+                    # Diagnose through the dense plane: an
+                    # uninitialized relaunched shard re-seeds, a
+                    # restarted one reconciles; either way the
+                    # minibatch surfaces as retryable.
+                    self._recover_embedding_failure(err)
             padded_rows = np.zeros(
                 (flat.size, self._emb_dims[table]), np.float32
             )
@@ -402,6 +563,10 @@ class ParameterServerTrainer(Trainer):
     # -- Trainer API --------------------------------------------------------
 
     def train_minibatch(self, features, labels):
+        # A PS restart noted since the last step (push response or
+        # prefetch-era pull carried a new generation) reconciles BEFORE
+        # any state from the dead incarnation is consumed.
+        self._maybe_reconcile()
         if self._steps % self._get_model_steps == 0:
             # Pipelined mode: drain in-flight pushes first.  A pull
             # racing a push convoys on the servicer lock behind the
@@ -446,17 +611,29 @@ class ParameterServerTrainer(Trainer):
                 for table, (uniq_ids, n_uniq) in push_info.items():
                     rows = np.asarray(emb_grads[table])[:n_uniq]
                     emb_push[table] = (rows, uniq_ids)
-                push = (
-                    self._ps.push_gradients_atomic if self._atomic_sync
-                    else self._ps.push_gradients
-                )
-                accepted, version = push(
-                    named_grads, emb_push,
-                    version=self._version,
-                    learning_rate=self._learning_rate,
-                )
+                if self._atomic_sync:
+                    accepted, version = self._ps.push_gradients_atomic(
+                        named_grads, emb_push,
+                        version=self._version,
+                        learning_rate=self._learning_rate,
+                    )
+                else:
+                    accepted, version = self._ps.push_gradients(
+                        named_grads, emb_push,
+                        version=self._version,
+                        learning_rate=self._learning_rate,
+                        # Same frozen stamp as the pipelined path: the
+                        # gradients belong to the incarnations the last
+                        # sync observed.
+                        generations=self._gen_snapshot,
+                    )
         if not accepted:
-            self._pull_dense()
+            # Generation-fenced reject (shard restarted, or a 2PC
+            # prepare/commit aborted across a mid-transaction shard
+            # death): reconcile with a forced full pull; a plain
+            # staleness reject re-pulls at the normal fast path.
+            if not self._maybe_reconcile():
+                self._pull_dense()
             raise GradientsRejected(
                 "stale gradients at version %d" % self._version
             )
@@ -519,8 +696,11 @@ class ParameterServerTrainer(Trainer):
 
     def evaluate_minibatch(self, features, labels):
         # Flush pending pushes so evaluation reads a PS state that
-        # includes everything this worker trained.
+        # includes everything this worker trained — and reconcile a
+        # noted PS restart first, so eval never mixes prefetched rows
+        # from a dead incarnation with restored dense state.
         self.drain_pushes()
+        self._maybe_reconcile()
         n = jax.tree_util.tree_leaves(features)[0].shape[0]
         (features, labels), _ = _pad_batch(
             (features, labels), self._batch_size

@@ -44,6 +44,29 @@ EDITS = [
     # folding them into the next job's creation window.
     ("ReportEvaluationMetricsRequest", "model_version", 4, F.TYPE_INT32,
      "modelVersion"),
+    # PS restart-generation fencing (docs/ps_recovery.md): every pull/
+    # push on the PS data plane carries the serving incarnation; pushes
+    # stamped by a dead incarnation are rejected, pulls from a client
+    # that observed an older incarnation bypass the version fast path.
+    ("PullDenseParametersRequest", "generation", 2, F.TYPE_INT64,
+     "generation"),
+    ("PullDenseParametersResponse", "generation", 4, F.TYPE_INT64,
+     "generation"),
+    ("PushGradientsRequest", "generation", 3, F.TYPE_INT64,
+     "generation"),
+    ("PushGradientsResponse", "generation", 3, F.TYPE_INT64,
+     "generation"),
+    ("PrepareGradientsRequest", "generation", 4, F.TYPE_INT64,
+     "generation"),
+    # PS shards tag their version reports with recovery state; the
+    # cross-shard min of durable_version is the coordinated-checkpoint
+    # commit mark the master (and drills) can read.
+    ("ReportVersionRequest", "is_ps", 2, F.TYPE_BOOL, "isPs"),
+    ("ReportVersionRequest", "ps_id", 3, F.TYPE_INT32, "psId"),
+    ("ReportVersionRequest", "generation", 4, F.TYPE_INT64,
+     "generation"),
+    ("ReportVersionRequest", "durable_version", 5, F.TYPE_INT32,
+     "durableVersion"),
 ]
 
 

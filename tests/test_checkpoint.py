@@ -56,20 +56,21 @@ def test_reroute_shard_counts(tmp_path):
     assert sorted(all_ids) == list(range(10))
 
 
-def test_per_shard_fallback_when_versions_drift(tmp_path):
-    """Shards checkpointing at drifting version labels stay restorable:
-    load_shard(None, i, N) falls back to shard i's own newest file when no
-    fully-valid version exists (ADVICE r1: torn dirs made zero checkpoints
-    restorable)."""
+def test_drifted_shard_set_refused_loudly(tmp_path):
+    """Coordinated restore (docs/ps_recovery.md): a directory holding
+    only drifted per-shard files — no label complete across the shard
+    set — REFUSES to restore rather than silently handing shard 0 a
+    version-100 slice and shard 1 a version-97 slice of one dense
+    model."""
+    import pytest
+
     saver = CheckpointSaver(str(tmp_path))
     saver.save_shard(100, 0, 2, dense={"a": np.full(2, 7, np.float32)})
     saver.save_shard(97, 1, 2, dense={"b": np.full(2, 9, np.float32)})
     assert saver.versions() == []  # no fully-valid version anywhere
-    d0, _, v0 = saver.load_shard(None, 0, 2)
-    d1, _, v1 = saver.load_shard(None, 1, 2)
-    assert v0 == 100 and v1 == 97
-    np.testing.assert_array_equal(d0["a"], np.full(2, 7, np.float32))
-    np.testing.assert_array_equal(d1["b"], np.full(2, 9, np.float32))
+    for shard in range(2):
+        with pytest.raises(FileNotFoundError, match="mixed-version"):
+            saver.load_shard(None, shard, 2)
 
 
 def test_per_shard_gc_prunes_torn_dirs(tmp_path):
@@ -102,19 +103,23 @@ def test_optimizer_slots_route_with_parent_param(tmp_path):
                 )
 
 
-def test_newer_per_shard_checkpoint_beats_old_full_version(tmp_path):
-    """A fully-valid label from early in the job must not roll a shard
-    back past its own later per-shard checkpoints."""
+def test_restore_uses_committed_label_not_newer_shard_file(tmp_path):
+    """Every shard restores the newest COMMITTED (fully-valid) label —
+    a lone shard's newer uncommitted file is part of no consistent cut
+    and must not pull that one shard ahead of its siblings."""
     saver = CheckpointSaver(str(tmp_path))
     saver.save(100, dense={"a": np.full(1, 1, np.float32),
                            "b": np.full(1, 1, np.float32)}, num_shards=2)
     # Later, drifted per-shard writes (no complete version forms).
     saver.save_shard(150, 0, 2, dense={"a": np.full(1, 5, np.float32)})
-    d0, _, v0 = saver.load_shard(None, 0, 2)
-    assert v0 == 150 and d0["a"][0] == 5
-    # Shard 1 has nothing newer: falls back to the full version-100.
-    _, _, v1 = saver.load_shard(None, 1, 2)
-    assert v1 == 100
+    merged = {}
+    for shard in range(2):
+        d, _, v = saver.load_shard(None, shard, 2)
+        assert v == 100
+        merged.update(d)
+    assert merged["a"][0] == 1 and merged["b"][0] == 1
+    # The resume math the master uses agrees with what restores.
+    assert saver.latest_resumable_version(2) == 100
 
 
 def test_gc_never_tears_a_full_version(tmp_path):

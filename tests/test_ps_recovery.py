@@ -1,0 +1,657 @@
+"""PS crash-restart recovery (docs/ps_recovery.md): restart-generation
+fencing, coordinated cross-shard checkpoints, worker outage-riding and
+rollback reconciliation — the unit half of bench_elastic's cpu_ps_kill
+drill."""
+
+import os
+import threading
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.models import deepfm
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.proto import rpc
+from elasticdl_tpu.ps.server import establish_generation
+from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.utils.checkpoint import CheckpointSaver
+from elasticdl_tpu.utils.retry import RetryPolicy, ps_rpc_policy
+from elasticdl_tpu.worker.ps_client import PSClient, build_ps_client
+from elasticdl_tpu.worker.ps_trainer import (
+    GradientsRejected,
+    ParameterServerTrainer,
+)
+from tests.test_pserver import start_ps, stop_all
+
+VOCAB = 200
+
+
+def make_spec():
+    return deepfm.model_spec(vocab_size=VOCAB, embedding_dim=4,
+                             hidden=(8,))
+
+
+def make_batches(spec, n=128, batch_size=32):
+    dense, ids, labels = deepfm.synthetic_data(n=n, vocab_size=VOCAB,
+                                               seed=7)
+    out = []
+    for i in range(0, n, batch_size):
+        records = [(dense[j], ids[j], labels[j])
+                   for j in range(i, i + batch_size)]
+        out.append(spec.feed(records))
+    return out
+
+
+def simulate_restart(servicer, generation, rollback_to=None):
+    """In-process stand-in for SIGKILL + relaunch-with-restore on the
+    same port: the serving incarnation's generation bumps and (with
+    ``rollback_to``) the params version rolls back to the restored
+    checkpoint label."""
+    servicer.generation = generation
+    servicer._staged.clear()   # staged 2PC txns died with the process
+    if rollback_to is not None:
+        servicer._params.version = rollback_to
+
+
+# -- restart-generation establishment -----------------------------------
+
+
+def test_generation_monotone_across_restarts(tmp_path):
+    d = str(tmp_path)
+    assert establish_generation(d, 0) == 1
+    assert establish_generation(d, 0) == 2
+    assert establish_generation(d, 0) == 3
+    # Sibling shards count independently.
+    assert establish_generation(d, 1) == 1
+
+
+def test_generation_hint_moves_forward_only(tmp_path):
+    d = str(tmp_path)
+    # Persisted counter lost (fresh dir) but the launcher knows this is
+    # launch #4: the hint wins.
+    assert establish_generation(d, 0, hint=4) == 4
+    # Persisted 4 now beats a stale/lower hint.
+    assert establish_generation(d, 0, hint=2) == 5
+
+
+def test_generation_without_dir_is_constant():
+    # Nothing to persist against and no hint: constant 1 (fencing needs
+    # a persisted counter or a counting launcher).
+    assert establish_generation("", 0) == 1
+    assert establish_generation("", 0) == 1
+
+
+# -- servicer fencing ----------------------------------------------------
+
+
+def test_push_from_dead_incarnation_rejected_not_applied():
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        accepted, _ = client.push_gradients(
+            {"w": np.full(4, 0.5, np.float32)}, version=0
+        )
+        assert accepted
+        _, _, before = client.pull_dense_parameters(-1)
+
+        # The shard restarts under the client (rolled back to v0); the
+        # client still stamps generation 1.
+        simulate_restart(servicers[0], generation=2, rollback_to=0)
+        assert client.known_generation(0) == 1
+        accepted, _ = client.push_gradients(
+            {"w": np.full(4, 100.0, np.float32)}, version=1
+        )
+        assert not accepted
+        assert servicers[0].counters["push_gen_rejected"] == 1
+        # NOT applied — in async mode the version check alone would
+        # have taken this as a "future-version" gradient.
+        np.testing.assert_array_equal(
+            servicers[0]._params.get_dense()["w"], before["w"]
+        )
+        # The reject response carried the new generation: the client
+        # noted it and bumped its reconcile epoch.
+        assert client.known_generation(0) == 2
+        assert client.generation_epoch == 1
+    finally:
+        stop_all(servers)
+
+
+def test_frozen_generation_snapshot_fences_deferred_push():
+    """A deferred (pipelined) push is stamped with the generation its
+    gradients were computed under — the caller's frozen snapshot — not
+    whatever the client learned by the time it executes.  Otherwise an
+    earlier push's fenced reject would teach the client the new
+    generation and let the NEXT queued dead-incarnation gradient ride
+    in under it."""
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        client.pull_dense_parameters(-1)
+        frozen = client.generation_snapshot()
+        assert frozen == [1]
+
+        simulate_restart(servicers[0], generation=2, rollback_to=0)
+        # The client learns the restart (e.g. an earlier queued push
+        # was fenced)...
+        client.pull_dense_parameters(-1)
+        assert client.known_generation(0) == 2
+        # ...but the deferred push still carries the FROZEN stamp and
+        # must be fenced.
+        accepted, _ = client.push_gradients(
+            {"w": np.full(4, 7.0, np.float32)}, version=1,
+            generations=frozen,
+        )
+        assert not accepted
+        assert servicers[0].counters["push_gen_rejected"] == 1
+    finally:
+        stop_all(servers)
+
+
+def test_unstamped_legacy_push_still_accepted():
+    client, servicers, servers = start_ps(num_ps=1, generation=3)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        # Hand-built legacy request: generation unset (0).
+        from elasticdl_tpu.utils import tensor_codec
+
+        model = tensor_codec.model_to_pb(
+            dense={"w": np.full(4, 0.5, np.float32)}, version=0
+        )
+        res = servicers[0].push_gradients(
+            pb.PushGradientsRequest(gradients=model)
+        )
+        assert res.accepted and res.generation == 3
+    finally:
+        stop_all(servers)
+
+
+def test_pull_with_stale_generation_bypasses_fast_path():
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        for v in range(4):
+            client.push_gradients(
+                {"w": np.full(4, 0.5, np.float32)}, version=v
+            )
+        # Client is at v4; the shard restarts restored at v2 — the
+        # server's version is BELOW the client's, so the plain fast
+        # path would return nothing forever.
+        simulate_restart(servicers[0], generation=2, rollback_to=2)
+        initialized, version, dense = client.pull_dense_parameters(4)
+        assert initialized and version == 2
+        assert "w" in dense, (
+            "rolled-back shard starved the stale-generation client "
+            "through the version fast path"
+        )
+        # Same request from a client already AT the new generation
+        # takes the fast path again (no redundant payload).
+        _, _, dense2 = client.pull_dense_parameters(4)
+        assert dense2 == {}
+    finally:
+        stop_all(servers)
+
+
+def test_mixed_generation_prepare_aborts_2pc_on_every_shard():
+    """Sync-mode 2PC across a mid-transaction shard restart: the
+    restarted shard fences its prepare, so the coordinator aborts the
+    commit on EVERY shard — versions advance nowhere."""
+    client, servicers, servers = start_ps(
+        num_ps=2, use_async=False, grads_to_wait=1, generation=1,
+    )
+    try:
+        client.push_model(
+            {"a": np.ones(4, np.float32), "b": np.ones(4, np.float32)}
+        )
+        accepted, _ = client.push_gradients_atomic(
+            {"a": np.full(4, 0.5, np.float32),
+             "b": np.full(4, 0.5, np.float32)}, version=0,
+        )
+        assert accepted
+        versions = [s._params.version for s in servicers]
+
+        simulate_restart(servicers[0], generation=2)
+        accepted, _ = client.push_gradients_atomic(
+            {"a": np.full(4, 9.0, np.float32),
+             "b": np.full(4, 9.0, np.float32)}, version=1,
+        )
+        assert not accepted
+        assert [s._params.version for s in servicers] == versions, (
+            "an aborted 2PC half-applied on a surviving shard"
+        )
+        assert servicers[0].counters["push_gen_rejected"] == 1
+        assert client.generation_epoch == 1
+    finally:
+        stop_all(servers)
+
+
+# -- worker outage riding ------------------------------------------------
+
+
+def test_client_rides_shard_relaunch_on_same_port():
+    """Kill the in-process server and boot a fresh one on the SAME
+    port mid-retry: the armed client rebuilds its channel and the pull
+    lands on the new incarnation without the caller seeing an error."""
+    from elasticdl_tpu.ps.optimizer import create_optimizer
+    from elasticdl_tpu.ps.parameters import Parameters
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    def boot(port, generation):
+        params = Parameters()
+        servicer = PserverServicer(
+            params, create_optimizer("sgd", "learning_rate=0.1"),
+            ps_id=0, num_ps=1, generation=generation,
+        )
+        server = grpc_utils.build_server(max_workers=8)
+        rpc.add_pserver_servicer(servicer, server)
+        port = server.add_insecure_port("[::]:%d" % port)
+        server.start()
+        return servicer, server, port
+
+    servicer, server, port = boot(0, generation=1)
+    addr = "localhost:%d" % port
+    client = build_ps_client(
+        [addr], retry=ps_rpc_policy(deadline_secs=30.0)
+    )
+    client.push_model({"w": np.ones(4, np.float32)})
+    client.pull_dense_parameters(-1)   # learn the serving generation
+    assert client.known_generation(0) == 1
+
+    server.stop(grace=None)
+    relaunched = {}
+
+    def relaunch_later():
+        servicer2, server2, _ = boot(port, generation=2)
+        relaunched["servicer"] = servicer2
+        relaunched["server"] = server2
+
+    timer = threading.Timer(1.0, relaunch_later)
+    timer.start()
+    try:
+        # Rides the dead window (~1s), lands on generation 2, which is
+        # uninitialized — exactly what the trainer's push-to-init path
+        # consumes.
+        initialized, _, _ = client.pull_dense_parameters(-1)
+        assert not initialized
+        assert client.known_generation(0) == 2
+        assert client.generation_epoch == 1
+    finally:
+        timer.join()
+        if "server" in relaunched:
+            relaunched["server"].stop(grace=None)
+
+
+def test_fail_fast_without_policy():
+    """No retry policy (legacy construction): a dead shard surfaces
+    immediately as RpcError — the worker-level minibatch retry is then
+    the only ride-out, as before this PR."""
+    client, servicers, servers = start_ps(num_ps=1)
+    client.push_model({"w": np.ones(4, np.float32)})
+    stop_all(servers)
+    with pytest.raises(grpc.RpcError):
+        client.pull_dense_parameters(-1)
+
+
+# -- trainer rollback reconciliation ------------------------------------
+
+
+def test_trainer_reconciles_rollback_past_fast_path():
+    """Shard restarts restored at an OLDER version, detected by the
+    fenced push (get_model_steps > 1, so no cadence pull intervenes):
+    the trainer re-pulls the FULL dense state — bypassing its
+    local-version fast path, which a rolled-back server would starve —
+    and resumes from the restored params."""
+    spec = make_spec()
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        trainer = ParameterServerTrainer(spec, client, batch_size=32,
+                                         get_model_steps=4)
+        data = make_batches(spec)
+        for features, labels in data[:3]:
+            trainer.train_minibatch(features, labels)
+        assert servicers[0]._params.version == 3
+
+        # Restart restored at v1 — and zero the server's actual dense
+        # payload in place so the forced re-pull is observable (a
+        # fast-path pull would return version 1 with NO data, leaving
+        # the local params silently stale).
+        with servicers[0]._lock:
+            for arr in servicers[0]._params.get_dense().values():
+                arr[...] = 0.0
+        simulate_restart(servicers[0], generation=2, rollback_to=1)
+
+        with pytest.raises(GradientsRejected):
+            trainer.train_minibatch(*data[3])
+        # The reconcile already ran inside the reject path: version AND
+        # payload adopted from the restored shard, past the fast path.
+        assert trainer.version == 1
+        for name, arr in trainer.export_parameters().items():
+            np.testing.assert_array_equal(
+                arr, np.zeros_like(arr),
+                err_msg="%s kept the dead incarnation's value" % name,
+            )
+        assert trainer._seen_gen_epoch == client.generation_epoch == 1
+        # The worker's normal retry loop then succeeds.
+        loss, _ = trainer.train_minibatch(*data[3])
+        assert np.isfinite(loss)
+    finally:
+        stop_all(servers)
+
+
+def test_cadence_pull_rides_restart_without_a_reject():
+    """At get_model_steps=1 the cadence pull reaches the restarted
+    shard FIRST, still stamped with the old generation: the server's
+    stale-generation bypass hands back the full restored state, the
+    push that follows is stamped with the new generation, and training
+    rides the restart without even a GradientsRejected."""
+    spec = make_spec()
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        trainer = ParameterServerTrainer(spec, client, batch_size=32)
+        data = make_batches(spec)
+        for features, labels in data[:3]:
+            trainer.train_minibatch(features, labels)
+
+        with servicers[0]._lock:
+            for arr in servicers[0]._params.get_dense().values():
+                arr[...] = 0.0
+        simulate_restart(servicers[0], generation=2, rollback_to=1)
+
+        loss, _ = trainer.train_minibatch(*data[3])
+        assert np.isfinite(loss)
+        assert trainer.version == 1
+        assert client.known_generation(0) == 2
+        assert servicers[0].counters["push_gen_rejected"] == 0
+    finally:
+        stop_all(servers)
+
+
+def test_pipelined_pushes_dropped_not_misapplied():
+    """async_push_window > 0: pushes queued behind the compute when the
+    shard dies are stamped by the dead incarnation — on reconcile they
+    are waited out and DROPPED (the shard fences each), never surfaced
+    as staleness rejects nor re-pushed against restored state."""
+    spec = make_spec()
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        trainer = ParameterServerTrainer(
+            spec, client, batch_size=32, get_model_steps=4,
+            async_push_window=2,
+        )
+        data = make_batches(spec)
+        trainer.train_minibatch(*data[0])
+        trainer.drain_pushes()
+        v_applied = servicers[0]._params.version
+        accepted_before = servicers[0].counters["push_accepted"]
+
+        simulate_restart(servicers[0], generation=2,
+                         rollback_to=v_applied)
+        # These steps pipeline pushes stamped with the generation the
+        # local params were last SYNCED under (gen 1 — unless the
+        # executor's fenced reject lands between them, in which case
+        # the second step reconciles first and its push legitimately
+        # carries gen 2; both interleavings are valid, the invariant
+        # below is interleaving-free).
+        trainer.train_minibatch(*data[1])
+        trainer.train_minibatch(*data[2])
+        # Seed a prefetched entry to prove invalidation.
+        trainer._prefetched[("emb", b"sentinel")] = None
+        # next step hits the reconcile path (epoch bumped by the fenced
+        # push responses); the queued pushes drop, nothing mis-applies.
+        trainer.train_minibatch(*data[3])
+        trainer.drain_pushes()
+        fenced = servicers[0].counters["push_gen_rejected"]
+        accepted = servicers[0].counters["push_accepted"] - accepted_before
+        assert fenced >= 1
+        # Every one of the 3 post-restart pushes either fenced or was
+        # stamped AFTER a reconcile re-synced local state — and the
+        # restored version advanced by exactly the accepted ones: a
+        # dead-incarnation push slipping through would break the
+        # accounting.
+        assert fenced + accepted == 3
+        assert servicers[0]._params.version == v_applied + accepted, (
+            "a dead-incarnation push was applied to restored state "
+            "(or a drop surfaced as a staleness retry)"
+        )
+        assert ("emb", b"sentinel") not in trainer._prefetched
+        assert trainer._seen_gen_epoch == client.generation_epoch
+        assert trainer.timing.counters().get("ps_reconcile", 0) >= 1
+        trainer.close()
+    finally:
+        stop_all(servers)
+
+
+def test_uninitialized_relaunch_reseeded_mid_run():
+    """A shard that comes back with NO restorable checkpoint re-enters
+    the uninitialized state; the reconcile path re-seeds it from the
+    local model (push-to-init) instead of wedging pulls."""
+    from elasticdl_tpu.ps.parameters import Parameters
+
+    spec = make_spec()
+    client, servicers, servers = start_ps(num_ps=1, generation=1)
+    try:
+        trainer = ParameterServerTrainer(spec, client, batch_size=32,
+                                         get_model_steps=4)
+        data = make_batches(spec)
+        trainer.train_minibatch(*data[0])
+
+        # Relaunch with empty state on the same port.
+        fresh = Parameters()
+        servicers[0]._params = fresh
+        simulate_restart(servicers[0], generation=2)
+        with pytest.raises(GradientsRejected):
+            trainer.train_minibatch(*data[1])
+        assert fresh.initialized, "reconcile did not re-seed the shard"
+        loss, _ = trainer.train_minibatch(*data[1])
+        assert np.isfinite(loss)
+    finally:
+        stop_all(servers)
+
+
+# -- coordinated checkpoints --------------------------------------------
+
+
+def test_truncate_shard_after_removes_abandoned_timeline(tmp_path):
+    saver = CheckpointSaver(str(tmp_path))
+    for v in (8, 16):
+        saver.save(v, dense={"a": np.full(1, v, np.float32),
+                             "b": np.full(1, v, np.float32)},
+                   num_shards=2)
+    # Shard 0 raced ahead on the dead timeline before the crash.
+    saver.save_shard(24, 0, 2, dense={"a": np.full(1, 99, np.float32)})
+    victims = saver.truncate_shard_after(16, 0, 2)
+    assert victims == [24]
+    assert saver.shard_versions(0, 2) == [8, 16]
+    # Committed labels untouched.
+    assert saver.latest_version() == 16
+
+
+def test_servicer_checkpoint_failure_surfaces(tmp_path):
+    """A failed save bumps ps_ckpt_failed and durable_version stays at
+    the last version actually on disk, so the report to the master
+    carries the TRUE durable mark."""
+    client, servicers, servers = start_ps(
+        num_ps=1, generation=1,
+        checkpoint_saver=CheckpointSaver(str(tmp_path)),
+        checkpoint_steps=1,
+    )
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        client.push_gradients({"w": np.full(4, 0.5, np.float32)},
+                              version=0)
+        assert servicers[0].durable_version == 1
+        # Break the checkpoint dir: point it UNDER a regular file, so
+        # the save's makedirs raises (chmod tricks don't stop root).
+        blocker = os.path.join(str(tmp_path), "blocker")
+        with open(blocker, "w") as fh:
+            fh.write("x")
+        servicers[0]._checkpoint_saver._dir = os.path.join(
+            blocker, "nested"
+        )
+        client.push_gradients({"w": np.full(4, 0.5, np.float32)},
+                              version=1)
+        assert servicers[0].counters["ps_ckpt_failed"] >= 1
+        assert servicers[0].durable_version == 1
+    finally:
+        stop_all(servers)
+
+
+def test_master_tracks_commit_mark_and_rollback():
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_manager import TaskManager
+
+    ms = MasterServicer(TaskManager(training_shards=[],
+                                    records_per_task=1))
+
+    def report(ps_id, version, generation, durable):
+        ms.report_version(pb.ReportVersionRequest(
+            model_version=version, is_ps=True, ps_id=ps_id,
+            generation=generation, durable_version=durable,
+        ))
+
+    assert ms.ps_commit_mark() is None
+    report(0, 16, 1, 16)
+    report(1, 16, 1, 8)
+    # Commit mark = cross-shard MIN of durable versions.
+    assert ms.ps_commit_mark() == 8
+    state = ms.ps_state()
+    assert state[0]["generation"] == 1 and state[1]["durable_version"] == 8
+    # Shard 0 relaunches restored at 8: its durable mark must move
+    # BACK with it (not max-folded) — recovery would really lose the
+    # gap.
+    report(0, 8, 2, 8)
+    assert ms.ps_state()[0]["generation"] == 2
+    assert ms.ps_commit_mark() == 8
+    # A DELAYED report from the dead incarnation (outage-riding retry
+    # landing late) must not float the mark back up: its durable file
+    # may have been truncated by the restore.
+    report(0, 16, 1, 16)
+    assert ms.ps_state()[0]["durable_version"] == 8
+    assert ms.ps_commit_mark() == 8
+    report(1, 24, 1, 24)
+    report(0, 24, 2, 24)
+    assert ms.ps_commit_mark() == 24
+    # Plain worker reports leave the PS plane alone.
+    ms.report_version(pb.ReportVersionRequest(model_version=99))
+    assert 99 not in ms.ps_state()
+
+
+# -- PSManager lifecycle -------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, code=0, term_hangs=False, dead=False):
+        self._code = code
+        self.pid = 4242
+        self.terminated = False
+        self.killed = False
+        self._term_hangs = term_hangs
+        self._dead = dead
+
+    def poll(self):
+        if self._dead or self.killed or (
+            self.terminated and not self._term_hangs
+        ):
+            return self._code
+        return None
+
+    def wait(self, timeout=None):
+        import subprocess
+
+        if self.poll() is None:
+            raise subprocess.TimeoutExpired("fake", timeout)
+        return self._code
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+
+
+def _manager(**kwargs):
+    from elasticdl_tpu.master.ps_manager import PSManager
+
+    kwargs.setdefault("num_ps", 1)
+    kwargs.setdefault("opt_type", "sgd")
+    kwargs.setdefault("opt_args", "learning_rate=0.1")
+    return PSManager(**kwargs)
+
+
+def test_relaunch_budget_decays_after_healthy_uptime(monkeypatch):
+    import time as _time
+
+    mgr = _manager(max_relaunch=2, relaunch_decay_secs=100.0)
+    launches = []
+    monkeypatch.setattr(
+        mgr, "_launch", lambda ps_id, restore=False:
+        launches.append((ps_id, restore))
+    )
+    now = _time.monotonic()
+    # Two crashes in a row: budget counts up.
+    mgr._launched_at[0] = now
+    mgr._watch(0, _FakeProc(code=9, dead=True))
+    mgr._watch(0, _FakeProc(code=9, dead=True))
+    assert mgr._relaunches[0] == 2 and len(launches) == 2
+    # Budget would be spent — but this death follows a LONG healthy
+    # uptime, so the count resets and the relaunch proceeds.
+    mgr._launched_at[0] = now - 500.0
+    mgr._watch(0, _FakeProc(code=9, dead=True))
+    assert mgr._relaunches[0] == 1 and len(launches) == 3
+    # Fast crash right after: counts from the fresh budget.
+    mgr._launched_at[0] = _time.monotonic()
+    mgr._watch(0, _FakeProc(code=9, dead=True))
+    assert mgr._relaunches[0] == 2 and len(launches) == 4
+    # And the next fast crash exhausts it.
+    mgr._watch(0, _FakeProc(code=9, dead=True))
+    assert len(launches) == 4
+
+
+def test_stop_escalates_terminate_to_kill(monkeypatch):
+    mgr = _manager()
+    monkeypatch.setattr(mgr, "STOP_GRACE_SECS", 0.05)
+    monkeypatch.setattr(mgr, "STOP_KILL_WAIT_SECS", 0.05)
+    polite = _FakeProc()
+    wedged = _FakeProc(term_hangs=True)
+    mgr._procs = {0: polite, 1: wedged}
+    mgr.stop()
+    assert polite.terminated and not polite.killed
+    assert wedged.terminated and wedged.killed
+    assert mgr._stopped.is_set()
+
+
+def test_launch_args_carry_generation_and_fault_spec():
+    mgr = _manager(
+        checkpoint_dir="/ckpt", checkpoint_steps=8,
+        ps_fault_spec="push_gradients:every=5,code=UNAVAILABLE",
+    )
+    mgr._launch_counts[0] = 2  # two launches so far
+    args = mgr._args(0, restore=True, generation=3)
+    assert args[args.index("--generation") + 1] == "3"
+    assert args[args.index("--rpc_fault_spec") + 1] == (
+        "push_gradients:every=5,code=UNAVAILABLE"
+    )
+    assert "--checkpoint_dir_for_init" in args
+
+
+# -- retry policy --------------------------------------------------------
+
+
+def test_ps_rpc_policy_env_budget(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_RPC_DEADLINE_SECS", "7")
+    assert ps_rpc_policy().deadline_secs == 7.0
+    assert ps_rpc_policy(deadline_secs=3.0).deadline_secs == 3.0
+
+
+def test_proto_generation_fields_roundtrip():
+    req = pb.PushGradientsRequest(generation=5)
+    assert pb.PushGradientsRequest.FromString(
+        req.SerializeToString()
+    ).generation == 5
+    rv = pb.ReportVersionRequest(
+        model_version=4, is_ps=True, ps_id=1, generation=2,
+        durable_version=3,
+    )
+    back = pb.ReportVersionRequest.FromString(rv.SerializeToString())
+    assert (back.is_ps, back.ps_id, back.generation,
+            back.durable_version) == (True, 1, 2, 3)

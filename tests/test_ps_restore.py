@@ -42,6 +42,12 @@ def test_relaunched_adam_ps_applies_sparse_pushes(tmp_path):
     try:
         assert ps2.parameters.initialized
         assert ps2.parameters.version == 1
+        # Restart-generation fencing (docs/ps_recovery.md): the second
+        # incarnation serves a strictly newer generation, and the
+        # restored label seeds its durable mark (the commit mark must
+        # not drop to 0 on relaunch).
+        assert ps2.generation == 2
+        assert ps2.servicer.durable_version == 1
         # restored embedding row matches
         np.testing.assert_allclose(
             client2.pull_embedding_vectors("emb", [3]), emb_before
