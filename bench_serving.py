@@ -1,4 +1,30 @@
-"""Serving microbenchmark: serialized-lock baseline vs dynamic batcher.
+"""Serving microbenchmark: serialized-lock baseline vs dynamic batcher,
+plus (``--fleet``) the multi-replica fleet leg.
+
+The fleet leg (PR 9) measures the serving TIER, not one server: real
+replica subprocesses (each its own interpreter + XLA runtime — no
+shared GIL) behind the in-process router (serving/router.py):
+
+ - aggregate closed-loop ``:predict`` throughput, 1 replica vs 3
+   replicas behind the router, as interleaved timed blocks;
+ - a fleet hot-swap fired MID-STORM: a new export version rolls out
+   through the coordinator's barrier while keyed clients hammer —
+   reported: dropped requests (must be 0) and mixed-version pairs
+   (a version regression for one key; must be 0);
+ - PS-backed ``:lookup``: a table served straight from a live PS shard
+   (never exported to disk), verified bit-identical to the
+   exported-table path, with the hot-row-cache hit ratio scraped off
+   the replica's /metrics.
+
+Each replica is pinned to ONE core via taskset (the cpuset a
+per-container CPU limit would impose) in BOTH legs, so the 1-vs-3
+ratio measures fleet fan-out, not XLA intra-op threading — and the
+result JSON carries the rig's physical-core scaling ceiling, because a
+2-core box cannot express 3-replica scaling no matter how good the
+router is (the headline regime needs >= 4 cores or one host per
+replica).
+
+The original single-server comparison (default mode):
 
 Closed-loop concurrent clients (next request only after the previous
 response) hammer ``:predict`` on two endpoints over the SAME export:
@@ -223,6 +249,445 @@ class _Rig:
         }
 
 
+# -- fleet leg (PR 9) --------------------------------------------------
+
+FLEET_FEATURES = 64
+FLEET_HIDDEN = 1024
+FLEET_ROWS_PER_REQUEST = 64
+FLEET_CONCURRENCY = 6
+FLEET_REQUESTS_PER_CLIENT = 20
+FLEET_BLOCKS = 3
+
+
+def _free_port():
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def _export_fleet_version(base, version, bias=0.0):
+    """A compute-heavier MLP than the batching leg's: per-request
+    device time must dominate the HTTP/JSON shell so the fleet ratio
+    measures replicated EXECUTION, not the bench process's client
+    CPU."""
+    from elasticdl_tpu.serving.export import export_servable
+
+    rng = np.random.RandomState(7)
+    params = {
+        "w1": rng.randn(FLEET_FEATURES, FLEET_HIDDEN)
+        .astype(np.float32) * 0.03,
+        "w2": rng.randn(FLEET_HIDDEN, FLEET_HIDDEN)
+        .astype(np.float32) * 0.03,
+        "w3": rng.randn(FLEET_HIDDEN, CLASSES).astype(np.float32)
+        * 0.03,
+    }
+
+    def apply_fn(p, x):
+        import jax.numpy as jnp
+
+        h = jnp.maximum(x @ p["w1"], 0.0)
+        h = jnp.maximum(h @ p["w2"], 0.0)
+        return h @ p["w3"] + bias
+
+    export_servable(
+        os.path.join(base, str(version)), apply_fn, params,
+        np.zeros((1, FLEET_FEATURES), np.float32),
+        model_name="mlp", version=version, platforms=("cpu",),
+    )
+
+
+def _spawn_replica(base, port, ps_addrs="", cpu=None):
+    import shutil
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "ELASTICDL_TPU_PLATFORM": "cpu",
+        "OMP_NUM_THREADS": "1",
+        "OPENBLAS_NUM_THREADS": "1",
+    })
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.serving.server",
+        "--export_dir", base, "--host", "127.0.0.1",
+        "--port", str(port), "--fleet_managed", "true",
+        "--max_batch_size", str(MAX_BATCH),
+        "--batch_timeout_ms", "5",
+    ]
+    if cpu is not None and shutil.which("taskset"):
+        # One core per replica (the cpuset a per-container CPU limit
+        # would impose): XLA's intra-op pool otherwise grabs every
+        # visible core for ONE replica's matmuls, so the 1-vs-3 ratio
+        # would measure intra-op threading, not fleet fan-out.
+        cmd = ["taskset", "-c", str(cpu)] + cmd
+    if ps_addrs:
+        cmd += ["--ps_addrs", ps_addrs]
+    return subprocess.Popen(cmd, env=env)
+
+
+def _wait_http_ok(port, path="/healthz", timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=2)
+            conn.request("GET", path)
+            ok = conn.getresponse().status == 200
+            conn.close()
+            if ok:
+                return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+class _Fleet:
+    """N replica subprocesses behind an in-process router."""
+
+    def __init__(self, base, n, ps_addrs=""):
+        from elasticdl_tpu.serving.router import (
+            Router,
+            build_router_server,
+        )
+
+        n_cpus = len(os.sched_getaffinity(0))
+        self.procs = []
+        addrs = []
+        for i in range(n):
+            port = _free_port()
+            self.procs.append(_spawn_replica(
+                base, port, ps_addrs=ps_addrs, cpu=i % n_cpus))
+            addrs.append("127.0.0.1:%d" % port)
+        for addr in addrs:
+            assert _wait_http_ok(int(addr.rpartition(":")[2])), (
+                "replica %s did not come up" % addr)
+        self.router = Router(addrs, export_dir=base,
+                             probe_interval=0.25, poll_interval=1.0,
+                             barrier_timeout=120.0)
+        self.server = build_router_server(self.router, port=0)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.router.start(coordinate=True)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            status = self.router.fleet_status()
+            healthy = sum(1 for r in status["replicas"].values()
+                          if r["healthy"])
+            if healthy == n and status["committed_version"] >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("fleet did not become healthy: %s"
+                               % self.router.fleet_status())
+
+    def replica_metrics(self):
+        out = []
+        for addr in list(self.router.state.snapshot()[0]):
+            port = int(addr.rpartition(":")[2])
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=5)
+            conn.request("GET", "/metrics")
+            out.append(conn.getresponse().read().decode())
+            conn.close()
+        return out
+
+    def close(self):
+        import signal as _signal
+
+        self.router.stop()
+        self.server.shutdown()
+        self.server.server_close()
+        for proc in self.procs:
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)  # graceful drain
+        deadline = time.monotonic() + 15
+        for proc in self.procs:
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def _fleet_storm(port, concurrency, requests_per_client, keyed=False,
+                 payload_rows=FLEET_ROWS_PER_REQUEST):
+    """Closed-loop keep-alive clients against the router.  Returns
+    (elapsed_secs, ok_count, error_list, per_key_versions)."""
+    barrier = threading.Barrier(concurrency + 1)
+    errors = []
+    versions = {}
+
+    def client(idx):
+        body = {"instances": [[float((idx * 31 + j) % 17) / 17.0
+                               for j in range(FLEET_FEATURES)]
+                              for _ in range(payload_rows)]}
+        if keyed:
+            body["routing_key"] = "storm-%d" % idx
+        raw = json.dumps(body)
+        seen = versions.setdefault(idx, [])
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=120)
+        try:
+            conn.request("POST", "/v1/models/mlp:predict", body=raw)
+            resp = conn.getresponse()
+            resp.read()  # warm: connection + replica state
+            if resp.status != 200:
+                errors.append("warm: %d" % resp.status)
+                barrier.abort()
+                return
+            barrier.wait()
+            for _ in range(requests_per_client):
+                conn.request("POST", "/v1/models/mlp:predict",
+                             body=raw)
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.status != 200:
+                    errors.append((resp.status, payload[:200]))
+                    return
+                if keyed:
+                    seen.append(json.loads(payload)["model_version"])
+                else:
+                    # Throughput blocks: don't burn bench-process GIL
+                    # decoding payloads — status checked, bytes read.
+                    seen.append(0)
+        except threading.BrokenBarrierError:
+            pass
+        except Exception as e:  # noqa: BLE001 — a dropped request IS
+            # the failure the fleet drill counts
+            errors.append(repr(e))
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    try:
+        barrier.wait()
+    except threading.BrokenBarrierError:
+        pass
+    start = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    ok = sum(len(v) for v in versions.values())
+    return elapsed, ok, errors, versions
+
+
+def _run_fleet_throughput(base, requests_per_client):
+    """Interleaved 1-replica vs 3-replica blocks.  The headline ratio
+    is the MEDIAN of per-block ratios (the bench_zero idiom): each
+    block pairs the two fleets back-to-back, so the shared container's
+    CPU-steal noise — which far exceeds the effect at this core count —
+    cancels within a pair instead of corrupting a best-of comparison
+    across instants."""
+    rates = {1: [], 3: []}
+    fleets = {1: _Fleet(base, 1), 3: _Fleet(base, 3)}
+    try:
+        for block in range(FLEET_BLOCKS):
+            # Alternate leg order per block to cancel warmup drift.
+            order = [1, 3] if block % 2 == 0 else [3, 1]
+            for n in order:
+                elapsed, ok, errors, _ = _fleet_storm(
+                    fleets[n].port, FLEET_CONCURRENCY,
+                    requests_per_client)
+                if errors:
+                    raise RuntimeError("fleet-%d errors: %s"
+                                       % (n, errors[:3]))
+                rates[n].append(ok / elapsed)
+        # Hot-swap drill on the 3-replica fleet, mid-storm.
+        drill = _run_hotswap_drill(base, fleets[3])
+    finally:
+        for fleet in fleets.values():
+            fleet.close()
+    ratios = sorted(r3 / r1 for r1, r3 in zip(rates[1], rates[3]))
+    median_ratio = ratios[len(ratios) // 2]
+    return ({n: round(max(r), 1) for n, r in rates.items()},
+            round(median_ratio, 2), drill)
+
+
+def _run_hotswap_drill(base, fleet):
+    """Fire a new export version mid-storm; count drops and
+    mixed-version (per-key regression) pairs."""
+    swap_result = {}
+
+    def swap():
+        time.sleep(1.0)  # let the storm establish
+        _export_fleet_version(base, 2, bias=1.0)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if fleet.router.coordinator.committed_version == 2:
+                swap_result["committed"] = True
+                return
+            time.sleep(0.1)
+        swap_result["committed"] = False
+
+    swapper = threading.Thread(target=swap, daemon=True)
+    swapper.start()
+    elapsed, ok, errors, versions = _fleet_storm(
+        fleet.port, FLEET_CONCURRENCY, FLEET_REQUESTS_PER_CLIENT * 3,
+        keyed=True)
+    swapper.join(timeout=120)
+    mixed = 0
+    straddled = 0
+    for _key, seen in versions.items():
+        if seen != sorted(seen):
+            mixed += 1
+        if seen and seen[0] == 1 and seen[-1] == 2:
+            straddled += 1
+    return {
+        "committed": swap_result.get("committed", False),
+        "requests": ok,
+        "dropped_or_errored": len(errors),
+        "mixed_version_keys": mixed,
+        "keys_straddling_flip": straddled,
+        "storm_secs": round(elapsed, 1),
+    }
+
+
+def _run_ps_lookup_leg(tmp):
+    """A table served straight from a live PS shard — never exported —
+    bit-identical to the exported-table path, hit ratio on /metrics."""
+    from elasticdl_tpu.proto import rpc
+    from elasticdl_tpu.ps.optimizer import create_optimizer
+    from elasticdl_tpu.ps.parameters import Parameters
+    from elasticdl_tpu.ps.servicer import PserverServicer
+    from elasticdl_tpu.serving.export import export_servable
+    from elasticdl_tpu.utils import grpc_utils
+    from elasticdl_tpu.worker.ps_client import PSClient
+
+    servicer = PserverServicer(
+        Parameters(), create_optimizer("sgd", "learning_rate=0.1"),
+        generation=1)
+    ps_server = grpc_utils.build_server(max_workers=8)
+    rpc.add_pserver_servicer(servicer, ps_server)
+    ps_port = ps_server.add_insecure_port("[::]:0")
+    ps_server.start()
+    channel = grpc_utils.build_channel("localhost:%d" % ps_port)
+    grpc_utils.wait_for_channel_ready(channel)
+    seed_client = PSClient([channel])
+    n_rows, dim = 4096, 16
+    seed_client.push_model({}, embedding_infos=[
+        {"name": "users", "dim": dim, "initializer": "uniform"}])
+    trained = seed_client.pull_embedding_vectors(
+        "users", np.arange(n_rows))
+
+    base = os.path.join(tmp, "lookup_exports")
+    # The export embeds a COPY of the table under another name; "users"
+    # itself is never exported — it serves from the PS.
+    export_servable(
+        os.path.join(base, "1"),
+        lambda p, x: x @ p["w"],
+        {"w": np.zeros((2, 2), np.float32)},
+        np.zeros((1, 2), np.float32), model_name="mlp", version=1,
+        embeddings={"users_copy": (np.arange(n_rows), trained)},
+        platforms=("cpu",),
+    )
+    port = _free_port()
+    proc = _spawn_replica(base, port,
+                          ps_addrs="localhost:%d" % ps_port)
+    try:
+        assert _wait_http_ok(port)
+        rng = np.random.RandomState(11)
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        identical = True
+        lookups = 0
+        t0 = time.perf_counter()
+        for _ in range(200):
+            # Zipf-ish id mix: a hot head + a long tail, the access
+            # pattern the hot-row LRU exists for.
+            ids = np.concatenate([
+                rng.randint(0, 64, 48),
+                rng.randint(0, n_rows, 16),
+            ]).tolist()
+            out = {}
+            for table in ("users", "users_copy"):
+                conn.request("POST", "/v1/models/mlp:lookup",
+                             body=json.dumps({"table": table,
+                                              "ids": ids}))
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                assert resp.status == 200, payload
+                out[table] = (payload["source"],
+                              np.asarray(payload["vectors"],
+                                         np.float32))
+            assert out["users"][0] == "ps"
+            assert out["users_copy"][0] == "export"
+            identical = identical and bool(np.array_equal(
+                out["users"][1], out["users_copy"][1]))
+            lookups += 1
+        lookup_secs = time.perf_counter() - t0
+        conn.request("GET", "/metrics")
+        metrics = conn.getresponse().read().decode()
+        conn.close()
+        hit_ratio = None
+        for line in metrics.splitlines():
+            if line.startswith(
+                    "elasticdl_serving_emb_cache_hit_ratio"):
+                hit_ratio = float(line.rsplit(" ", 1)[1])
+        return {
+            "bit_identical_to_export_path": identical,
+            "lookups": lookups,
+            "lookups_per_sec": round(lookups / lookup_secs, 1),
+            "emb_cache_hit_ratio": hit_ratio,
+            "table_rows_served_from_ps": n_rows,
+        }
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait()
+        ps_server.stop(grace=None)
+
+
+def run_fleet_bench(requests_per_client=FLEET_REQUESTS_PER_CLIENT):
+    n_cpus = len(os.sched_getaffinity(0))
+    with tempfile.TemporaryDirectory() as tmp:
+        base = os.path.join(tmp, "fleet_exports")
+        _export_fleet_version(base, 1)
+        throughput, ratio, drill = _run_fleet_throughput(
+            base, requests_per_client)
+        lookup = _run_ps_lookup_leg(tmp)
+    # With R replicas pinned one-core-each, aggregate scaling is
+    # hard-capped by the physical core count — and the router + the
+    # closed-loop clients (one shared process here) compete for the
+    # SAME cores, so a 2-core rig cannot reach even 2x at any replica
+    # count.  Reported so the number can't be read as a fleet defect.
+    ceiling = round(min(3.0, float(n_cpus)), 2)
+    print(json.dumps({
+        "metric": "serving_fleet_throughput",
+        "value": ratio,
+        "unit": "x aggregate predict throughput (3 replicas vs 1 "
+                "behind the router, %d closed-loop clients, %d-row "
+                "requests, median of per-block ratios)"
+                % (FLEET_CONCURRENCY, FLEET_ROWS_PER_REQUEST),
+        "vs_baseline": None,
+        "detail": {
+            "best_requests_per_sec_by_replicas": {
+                str(n): rps for n, rps in sorted(throughput.items())},
+            "hotswap_drill": drill,
+            "ps_lookup_leg": lookup,
+            "replicas_are_subprocesses": True,
+            "cpuset": "one core per replica via taskset (a "
+                      "per-container CPU limit); router + clients "
+                      "share the same %d cores" % n_cpus,
+            "n_cpus": n_cpus,
+            "aggregate_scaling_ceiling_x": ceiling,
+            "baseline": "self-relative: 1 replica behind the same "
+                        "router IS the baseline; the 3-vs-1 regime "
+                        "this tier targets (each replica + the router "
+                        "on its own host/core) needs >= 4 cores",
+        },
+    }))
+    return ratio, drill, lookup
+
+
 def main(argv=None):
     import argparse
 
@@ -234,7 +699,16 @@ def main(argv=None):
     parser.add_argument("--max_batch_size", type=int, default=MAX_BATCH)
     parser.add_argument("--batch_timeout_ms", type=float,
                         default=TIMEOUT_MS)
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the multi-replica fleet leg (replica "
+                             "subprocesses behind the router, hot-swap "
+                             "mid-storm, PS-backed lookup) instead of "
+                             "the single-server batching comparison")
     args = parser.parse_args(argv)
+
+    if args.fleet:
+        run_fleet_bench()
+        return
 
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
         jax.config.update(

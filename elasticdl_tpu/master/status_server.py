@@ -15,6 +15,13 @@ pull surface on the master itself:
 Stdlib-only (ThreadingHTTPServer), read-only, zero coupling into the
 control plane beyond the objects it snapshots.  Enabled with
 ``--status_port`` (master flag); port 0 picks a free one.
+
+This module is also the home of every Prometheus exposition renderer in
+the system — the PS status page, the serving replicas' /metrics
+(``serving_to_prometheus``), and the fleet router's /metrics
+(``fleet_to_prometheus``) all share ``prometheus_line``, so the drills
+and a real scraper read ONE format across the control plane, the PS
+tier, and the serving tier.
 """
 
 import json
@@ -94,6 +101,81 @@ def to_prometheus(status):
                   shard["generation"], ps_id=str(ps_id))
             gauge("elasticdl_ps_shard_durable_version",
                   shard["durable_version"], ps_id=str(ps_id))
+    return "\n".join(lines) + "\n"
+
+
+def serving_to_prometheus(status):
+    """Serving-replica /metrics renderer (serving/server.py) — mirrors
+    the master's ``elasticdl_ps_commit_mark`` convention so the fleet
+    router, the drills, and a Prometheus scraper read ONE format across
+    the control plane and the serving tier.
+
+    ``status``: {"draining": bool, "models": {name: endpoint.stats()}}.
+    """
+    lines = [prometheus_line("elasticdl_serving_draining",
+                             int(status.get("draining", False)))]
+    for name, stats in sorted(status.get("models", {}).items()):
+        counters = stats.get("counters", {})
+
+        def gauge(metric, value, _model=name):
+            lines.append(prometheus_line(metric, value, model=_model))
+
+        gauge("elasticdl_serving_version", stats.get("version", 0))
+        gauge("elasticdl_serving_requests",
+              counters.get("batcher.requests", 0))
+        gauge("elasticdl_serving_batches",
+              counters.get("batcher.batches", 0))
+        occupancy = stats.get("mean_batch_occupancy")
+        if occupancy is not None:
+            gauge("elasticdl_serving_occupancy", occupancy)
+        wait = stats.get("timing", {}).get("batcher.queue_wait")
+        if wait:
+            gauge("elasticdl_serving_queue_wait_ms",
+                  1e3 * wait["mean_s"])
+        cache = stats.get("emb_cache")
+        if cache:
+            gauge("elasticdl_serving_emb_cache_bytes", cache["bytes"])
+            gauge("elasticdl_serving_emb_cache_rows", cache["rows"])
+            gauge("elasticdl_serving_emb_cache_evicted_rows",
+                  cache["evicted_rows"])
+            if cache.get("hit_ratio") is not None:
+                gauge("elasticdl_serving_emb_cache_hit_ratio",
+                      round(cache["hit_ratio"], 6))
+    return "\n".join(lines) + "\n"
+
+
+def fleet_to_prometheus(status):
+    """Router /metrics renderer (serving/router.py): the FLEET view —
+    committed version, per-replica health/load/version, routing
+    counters — in the same exposition format as everything else.
+
+    ``status``: the router's ``fleet_status()`` dict.
+    """
+    lines = [
+        prometheus_line("elasticdl_fleet_committed_version",
+                        status.get("committed_version", 0)),
+        prometheus_line("elasticdl_fleet_replicas_healthy",
+                        sum(1 for r in status.get("replicas", {})
+                            .values() if r.get("healthy"))),
+        prometheus_line("elasticdl_fleet_replicas_total",
+                        len(status.get("replicas", {}))),
+    ]
+    for addr, rep in sorted(status.get("replicas", {}).items()):
+        def gauge(metric, value, _addr=addr):
+            lines.append(prometheus_line(metric, value, replica=_addr))
+
+        gauge("elasticdl_fleet_replica_healthy",
+              int(rep.get("healthy", False)))
+        gauge("elasticdl_fleet_replica_serving_version",
+              rep.get("serving_version", 0))
+        gauge("elasticdl_fleet_replica_inflight",
+              rep.get("inflight", 0))
+        if rep.get("queue_wait_ms") is not None:
+            gauge("elasticdl_fleet_replica_queue_wait_ms",
+                  rep["queue_wait_ms"])
+    for name, value in sorted(status.get("counters", {}).items()):
+        lines.append(prometheus_line("elasticdl_fleet_router_counter",
+                                     value, name=name))
     return "\n".join(lines) + "\n"
 
 

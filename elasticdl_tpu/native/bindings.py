@@ -34,6 +34,8 @@ _lib.edl_table_dim.restype = _i64
 _lib.edl_table_size.argtypes = [_p]
 _lib.edl_table_size.restype = _i64
 _lib.edl_table_get.argtypes = [_p, _ip, _i64, _fp]
+_lib.edl_table_get_ro.argtypes = [_p, _ip, _i64, _fp, _f32]
+_lib.edl_table_get_ro.restype = _i64
 _lib.edl_table_set.argtypes = [_p, _ip, _i64, _fp]
 _lib.edl_table_export.argtypes = [_p, ctypes.c_void_p, ctypes.c_void_p,
                                   _i64]
@@ -106,6 +108,18 @@ class NativeEmbeddingTable:
         out = np.empty((ids.size, self.dim), np.float32)
         _lib.edl_table_get(self._h, ids, ids.size, out)
         return out
+
+    def get_ro(self, ids, default=0.0):
+        """Read-only batch get (the serving lookup path): absent ids
+        get ``default`` rows and are NOT lazily initialized — a
+        serving-time lookup must never grow the training table.  Runs
+        under the shared lock only, so lookups never serialize behind
+        each other.  Returns (rows, found_count)."""
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        out = np.empty((ids.size, self.dim), np.float32)
+        found = _lib.edl_table_get_ro(self._h, ids, ids.size, out,
+                                      float(default))
+        return out, int(found)
 
     def set(self, ids, values):
         ids = np.ascontiguousarray(ids, dtype=np.int64)

@@ -191,6 +191,30 @@ void edl_table_get(void* handle, const int64_t* ids, int64_t n,
   }
 }
 
+int64_t edl_table_get_ro(void* handle, const int64_t* ids, int64_t n,
+                         float* out, float fill) {
+  // Read-only batch get for the SERVING lookup path: absent ids are
+  // filled with `fill` and NEVER lazily initialized — serving traffic
+  // (arbitrary, possibly bogus ids from the internet) must not grow
+  // the training table or perturb its id set.  Runs entirely under the
+  // shared lock, so lookups never serialize behind each other.
+  // Returns the number of ids found.
+  Table* t = (Table*)handle;
+  int64_t found = 0;
+  std::shared_lock<std::shared_mutex> lock(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = t->rows.find(ids[i]);
+    if (it != t->rows.end()) {
+      std::memcpy(out + i * t->dim, it->second.data(),
+                  t->dim * sizeof(float));
+      ++found;
+    } else {
+      std::fill(out + i * t->dim, out + (i + 1) * t->dim, fill);
+    }
+  }
+  return found;
+}
+
 void edl_table_set(void* handle, const int64_t* ids, int64_t n,
                    const float* values) {
   Table* t = (Table*)handle;

@@ -118,6 +118,21 @@ class Parameters:
             return np.zeros((0, table.dim), np.float32)
         return table.get(ids)
 
+    def lookup_embedding_rows(self, name, ids, default=0.0):
+        """Read-only variant of :meth:`pull_embedding_vectors` for the
+        SERVING lookup path: absent ids come back as ``default`` rows
+        and are never lazily initialized, so serving traffic (arbitrary
+        ids from the internet) cannot grow the training table or
+        perturb its id set.  Same per-row atomicity as the training
+        pull (the native table's rw-lock); runs entirely under the
+        shared lock, so lookups never serialize behind each other."""
+        with self._lock:
+            table = self.embeddings[name]
+        if np.size(ids) == 0:
+            return np.zeros((0, table.dim), np.float32)
+        rows, _found = table.get_ro(ids, default=default)
+        return rows
+
     def to_checkpoint_payload(self):
         with self._lock:
             dense = {k: v.copy() for k, v in self.dense.items()}

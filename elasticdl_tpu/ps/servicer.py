@@ -83,7 +83,8 @@ class PserverServicer:
         # lost increments rather than re-serializing the hot RPC.
         self.counters = {"push_accepted": 0, "push_rejected": 0,
                          "push_gen_rejected": 0, "ps_ckpt_failed": 0,
-                         "pull_dense": 0, "pull_embedding": 0}
+                         "pull_dense": 0, "pull_embedding": 0,
+                         "pull_embedding_ro": 0}
 
     # -- RPCs ---------------------------------------------------------------
 
@@ -146,17 +147,35 @@ class PserverServicer:
         # a second lock acquisition), which async SGD tolerates by
         # design — the same per-row semantics as the reference's Go
         # table (embedding_table.go:41-58 under RWMutex).
-        self.counters["pull_embedding"] += 1
-        vectors = self._params.pull_embedding_vectors(
-            request.name, np.asarray(request.ids, np.int64)
-        )
+        if request.read_only:
+            # Serving-tier lookup (docs/serving.md fleet section): a
+            # read-mostly client must never grow the training table, so
+            # absent ids come back as zero rows instead of being lazily
+            # initialized — matching the exported-table lookup's
+            # ``default=0.0`` semantics bit for bit.
+            self.counters["pull_embedding_ro"] += 1
+            vectors = self._params.lookup_embedding_rows(
+                request.name, np.asarray(request.ids, np.int64)
+            )
+        else:
+            self.counters["pull_embedding"] += 1
+            vectors = self._params.pull_embedding_vectors(
+                request.name, np.asarray(request.ids, np.int64)
+            )
         # The master copy stays float32; the client may ask for a
         # reduced-precision wire encoding (request.wire_dtype, e.g.
         # "bfloat16") to halve the pull bandwidth — the codec upcasts
         # transparently on decode.
-        return tensor_codec.ndarray_to_pb(
+        res = tensor_codec.ndarray_to_pb(
             vectors, wire_dtype=request.wire_dtype or None
         )
+        # Generation stamp on the lookup response: an embedding-only
+        # client (the serving hot-row cache) otherwise never learns
+        # about a crash-restore rollback — this is the PR-8 fencing
+        # plane extended to the read-mostly path, so version-keyed
+        # caches can invalidate rows read from a dead incarnation.
+        res.generation = self.generation
+        return res
 
     def _fence(self, request_generation):
         """Restart fencing: a push/prepare stamped by another incarnation

@@ -1,0 +1,568 @@
+"""Fleet control plane for the serving tier.
+
+Two cooperating pieces, both owned by the router process
+(serving/router.py):
+
+ - :class:`FleetState` + :class:`HealthProber` — the replica table.
+   One prober thread polls each replica's existing ``/statz``
+   (docs/serving.md): a reply feeds the load signal (batch occupancy,
+   queue wait) and the replica's serving version; a miss EJECTS the
+   replica from routing.  Ejected replicas are ridden back in with
+   JITTERED exponential-backoff probes (the shared
+   ``utils/retry.RetryPolicy`` backoff math — deterministic per
+   process, so drills replay), exactly the outage-riding idiom the
+   worker's PS client uses for shard relaunches.
+
+ - :class:`FleetCoordinator` — fleet-wide hot-swap with no
+   mixed-version window.  The "all-N-ready then publish" idiom of the
+   PS tier's coordinated checkpoints (utils/checkpoint.py: a version
+   COMMITS only once every shard's file exists): a new complete export
+   version is first PRE-WARMED on every healthy replica
+   (``/fleet/prepare`` — the PR-3 background warm path, so no request
+   ever pays a cold XLA compile), the coordinator polls
+   ``/fleet/state`` until all of them report the version ready, and
+   only then runs the barrier: close the router's admission gate,
+   drain in-flight forwards, ``/fleet/commit`` everywhere, flip the
+   committed version, reopen.  Stale-version requests therefore DRAIN
+   before the flip — a client can never observe version V+1 and then
+   V again, for any key.
+
+   A replica that restarts mid-rollout rejoins at whatever version its
+   local disk gave it; the coordinator HEALS it to the fleet's
+   committed version (prepare + commit, no gate needed — it is not
+   routable until it matches) before routing touches it.  The
+   committed version is therefore seeded from the coordinator, never
+   from a rejoining replica's own disk scan, and a replica-side check
+   (``ModelEndpoint.commit_version`` refuses regressions) backs the
+   invariant even against a confused coordinator.
+"""
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+
+from elasticdl_tpu.serving.loader import list_versions
+from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.retry import serving_probe_policy
+
+logger = get_logger(__name__)
+
+
+def rendezvous_rank(key, addrs):
+    """Replicas ordered by highest-random-weight score for ``key``.
+
+    Each (key, replica) pair hashes independently, so removing a
+    replica only re-homes ITS keys (to their second choice) and adding
+    one steals ~1/N of each survivor's keyspace — no ring state to
+    persist or rebalance, which is why rendezvous beats a ring here
+    (the fleet is small and membership churns with every eject)."""
+    def score(addr):
+        return hashlib.blake2b(
+            ("%s|%s" % (key, addr)).encode(), digest_size=8,
+        ).digest()
+    return sorted(addrs, key=score, reverse=True)
+
+
+def pick_replica(key, addrs):
+    return rendezvous_rank(key, addrs)[0] if addrs else None
+
+
+def http_get_json(addr, path, timeout):
+    """One GET against a replica; fresh connection (control plane —
+    low rate, and a dead replica must not poison a pooled socket)."""
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host or addr, int(port),
+                                      timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        payload = resp.read()
+        if resp.status != 200:
+            raise OSError("GET %s on %s -> %d" % (path, addr,
+                                                  resp.status))
+        return json.loads(payload)
+    finally:
+        conn.close()
+
+
+def http_post_json(addr, path, payload, timeout):
+    host, _, port = addr.rpartition(":")
+    conn = http.client.HTTPConnection(host or addr, int(port),
+                                      timeout=timeout)
+    try:
+        body = json.dumps(payload).encode()
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        if resp.status != 200:
+            raise OSError("POST %s on %s -> %d" % (path, addr,
+                                                   resp.status))
+        return json.loads(raw)
+    finally:
+        conn.close()
+
+
+class _Replica:
+    """One replica's row in the table.  Plain data: every access goes
+    through FleetState under its lock."""
+
+    __slots__ = (
+        "addr", "healthy", "draining", "serving_version",
+        "occupancy", "queue_wait_ms", "inflight", "failures",
+        "next_probe_at", "ever_probed",
+    )
+
+    def __init__(self, addr):
+        self.addr = addr
+        self.healthy = False      # never routed before the first probe
+        self.draining = False
+        self.serving_version = 0
+        self.occupancy = None
+        self.queue_wait_ms = None
+        self.inflight = 0         # router-side live forwards
+        self.failures = 0         # consecutive probe/forward failures
+        self.next_probe_at = 0.0  # due immediately
+        self.ever_probed = False
+
+
+def _statz_view(statz):
+    """(serving_version, occupancy, queue_wait_ms, draining) out of a
+    replica's /statz payload.  Multi-model replicas report the MINIMUM
+    serving version — the fleet barrier must hold for every model the
+    replica hosts."""
+    models = statz.get("models", {})
+    version = min(
+        (int(stats.get("version", 0) or 0)
+         for stats in models.values()),
+        default=0,
+    )
+    occupancy = None
+    queue_wait_ms = None
+    for stats in models.values():
+        if stats.get("mean_batch_occupancy") is not None:
+            occupancy = stats["mean_batch_occupancy"]
+        wait = stats.get("timing", {}).get("batcher.queue_wait")
+        if wait and wait.get("count"):
+            queue_wait_ms = 1e3 * wait["mean_s"]
+    return version, occupancy, queue_wait_ms, bool(
+        statz.get("draining"))
+
+
+class FleetState:
+    """Concurrent replica table: probe results in, routing/load
+    decisions out.  All mutation under one lock; nothing blocking ever
+    runs under it (probes and forwards happen in the callers)."""
+
+    def __init__(self, addrs, probe_interval=0.5, backoff=None):
+        self.probe_interval = float(probe_interval)
+        self._backoff = backoff or serving_probe_policy()
+        self._lock = threading.Lock()
+        self._replicas = {addr: _Replica(addr) for addr in addrs}
+        self._counters = {}
+        self._rr = 0  # least-loaded tie rotation
+
+    # -- counters ------------------------------------------------------
+
+    def bump(self, name, n=1):
+        """Router observability counters (forwards, retries, ejects) —
+        bumped from many request threads, so guarded here rather than
+        relying on Timing's single-writer convention."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    # -- probe bookkeeping ---------------------------------------------
+
+    def due_probes(self, now):
+        with self._lock:
+            return [r.addr for r in self._replicas.values()
+                    if r.next_probe_at <= now]
+
+    def note_probe_ok(self, addr, statz, now):
+        version, occupancy, queue_wait_ms, draining = _statz_view(
+            statz)
+        with self._lock:
+            r = self._replicas[addr]
+            came_back = not r.healthy and r.ever_probed
+            r.healthy = True
+            r.ever_probed = True
+            r.draining = draining
+            r.serving_version = version
+            r.occupancy = occupancy
+            r.queue_wait_ms = queue_wait_ms
+            r.failures = 0
+            r.next_probe_at = now + self.probe_interval
+        if came_back:
+            logger.info("replica %s back in service (version %d%s)",
+                        addr, version,
+                        ", draining" if draining else "")
+
+    def note_probe_failure(self, addr, now):
+        with self._lock:
+            r = self._replicas[addr]
+            was_healthy = r.healthy
+            r.healthy = False
+            r.ever_probed = True
+            r.failures += 1
+            # Jittered exponential backoff toward a dead replica: probe
+            # attempt N waits the policy's delay for attempt N-1 (capped
+            # at its max), so a flapping replica is not hammered and a
+            # relaunch on the same port is still caught within seconds.
+            r.next_probe_at = now + self._backoff.delay_secs(
+                min(r.failures - 1, 8))
+        if was_healthy:
+            logger.warning("replica %s ejected (probe failure #%d)",
+                           addr, self._failures(addr))
+
+    def _failures(self, addr):
+        with self._lock:
+            return self._replicas[addr].failures
+
+    def note_committed(self, addr, version):
+        """A commit POST just succeeded on ``addr``: reflect its new
+        serving version NOW instead of waiting out a probe interval —
+        otherwise the instant after a fleet flip no replica would match
+        the new committed version and routing would blip empty."""
+        with self._lock:
+            r = self._replicas[addr]
+            r.serving_version = max(r.serving_version, int(version))
+
+    def note_forward_failure(self, addr, now):
+        """A live forward hit a dead socket: eject NOW (don't wait for
+        the prober) and schedule an immediate re-probe."""
+        with self._lock:
+            r = self._replicas[addr]
+            was_healthy = r.healthy
+            r.healthy = False
+            r.failures += 1
+            r.next_probe_at = now
+        if was_healthy:
+            logger.warning("replica %s ejected (forward failed)", addr)
+
+    # -- router-side load accounting -----------------------------------
+
+    def forward_finished(self, addr):
+        with self._lock:
+            self._replicas[addr].inflight -= 1
+
+    # -- routing views -------------------------------------------------
+
+    def _routable_locked(self, committed_version):
+        return [
+            r.addr for r in self._replicas.values()
+            if r.healthy and not r.draining and (
+                committed_version is None
+                or r.serving_version == committed_version)
+        ]
+
+    def routable(self, committed_version=None):
+        """Addresses traffic may go to: healthy, not draining, and —
+        when the fleet has a committed version — serving exactly it
+        (a healed-but-lagging or racing-ahead replica is NOT routable,
+        which is what makes the version flip atomic per key)."""
+        with self._lock:
+            return self._routable_locked(committed_version)
+
+    def acquire(self, committed_version, key=None, exclude=()):
+        """Pick a replica AND count the forward in-flight, atomically
+        (caller pairs with :meth:`forward_finished`).  Keyed requests
+        go by rendezvous hash; keyless take the least-loaded replica —
+        live in-flight first (exact and instant), then the probed
+        queue-wait/occupancy — with TIES rotated, not address-ordered.
+        The pick and the increment share one lock region: two
+        concurrent keyless requests can no longer both observe
+        inflight==0 on the same replica and herd onto it."""
+        with self._lock:
+            candidates = [a for a in
+                          self._routable_locked(committed_version)
+                          if a not in exclude]
+            if not candidates:
+                return None
+            if key is not None:
+                addr = pick_replica(key, candidates)
+            else:
+                def load(a):
+                    r = self._replicas[a]
+                    return (r.inflight, r.queue_wait_ms or 0.0,
+                            r.occupancy or 0.0)
+                best = min(load(a) for a in candidates)
+                tied = [a for a in candidates if load(a) == best]
+                self._rr += 1
+                addr = tied[self._rr % len(tied)]
+            self._replicas[addr].inflight += 1
+            return addr
+
+    def barrier_set(self):
+        """Replicas the rollout barrier must wait for: healthy and not
+        draining (a replica that dies mid-prepare drops out of the
+        wait on its next missed probe)."""
+        with self._lock:
+            return [r.addr for r in self._replicas.values()
+                    if r.healthy and not r.draining]
+
+    def serving_versions(self):
+        with self._lock:
+            return {r.addr: r.serving_version
+                    for r in self._replicas.values() if r.healthy}
+
+    def snapshot(self):
+        with self._lock:
+            counters = dict(self._counters)
+            return {
+                r.addr: {
+                    "healthy": r.healthy,
+                    "draining": r.draining,
+                    "serving_version": r.serving_version,
+                    "occupancy": r.occupancy,
+                    "queue_wait_ms": r.queue_wait_ms,
+                    "inflight": r.inflight,
+                    "failures": r.failures,
+                }
+                for r in self._replicas.values()
+            }, counters
+
+
+class HealthProber:
+    """One daemon thread polling each replica's /statz on its own
+    schedule (healthy: every ``probe_interval``; ejected: the jittered
+    backoff FleetState keeps per replica)."""
+
+    def __init__(self, state, probe_timeout=2.0):
+        self.state = state
+        self.probe_timeout = probe_timeout
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-prober")
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    def probe_once(self, now=None):
+        """One pass over every due replica (exposed for tests and for
+        the coordinator's pre-rollout refresh)."""
+        now = time.monotonic() if now is None else now
+        for addr in self.state.due_probes(now):
+            try:
+                statz = http_get_json(addr, "/statz",
+                                      self.probe_timeout)
+            except Exception:  # noqa: BLE001 — dead/hung/mid-restart
+                # replica: any failure mode means "not routable"
+                self.state.note_probe_failure(addr, time.monotonic())
+            else:
+                self.state.note_probe_ok(addr, statz,
+                                         time.monotonic())
+
+    def _run(self):
+        quantum = min(0.05, self.state.probe_interval / 4 or 0.05)
+        while not self._stop.is_set():
+            self.probe_once()
+            self._stop.wait(quantum)
+
+
+class FleetCoordinator:
+    """Version-barrier hot-swap over a FleetState (module docstring has
+    the protocol).  Driven by the router's rollout thread calling
+    :meth:`tick`; everything here runs OUTSIDE the routing hot path —
+    the only touch point is the admission gate around the commit."""
+
+    def __init__(self, state, export_dir, gate=None,
+                 http_timeout=5.0, barrier_timeout=120.0,
+                 ready_poll_secs=0.1):
+        self.state = state
+        self.export_dir = export_dir
+        self.gate = gate
+        self.http_timeout = http_timeout
+        self.barrier_timeout = barrier_timeout
+        self.ready_poll_secs = ready_poll_secs
+        # Single-writer (the rollout thread) once ticking starts;
+        # published for readers via the GIL-atomic attribute read —
+        # the router reads it per request, flipped only inside the
+        # closed-gate barrier.
+        self.committed_version = 0
+        self._seeded = False
+
+    # -- seeding -------------------------------------------------------
+
+    def seed_committed(self):
+        """First tick: adopt the fleet's actual state as the committed
+        version — the MAXIMUM any healthy replica serves (replicas only
+        move forward, so the max is what the fleet last agreed on; a
+        lagging rejoiner heals up to it).  An empty/unprobed fleet
+        falls back to the newest complete export on disk."""
+        versions = self.state.serving_versions()
+        if versions:
+            self.committed_version = max(versions.values())
+            self._seeded = True
+            logger.info("fleet committed version seeded from replicas: "
+                        "%d", self.committed_version)
+            return True
+        if self.export_dir:
+            try:
+                complete = list_versions(self.export_dir)
+            except OSError:
+                complete = []
+            if complete:
+                self.committed_version = complete[-1]
+                self._seeded = True
+                logger.info("fleet committed version seeded from "
+                            "export dir: %d", self.committed_version)
+                return True
+        return False
+
+    # -- rollout -------------------------------------------------------
+
+    def target_version(self):
+        """Newest complete export version beyond the committed one, or
+        None."""
+        if not self.export_dir:
+            return None
+        versions = list_versions(self.export_dir)
+        if versions and versions[-1] > self.committed_version:
+            return versions[-1]
+        return None
+
+    def tick(self):
+        """One coordination pass: seed if needed, heal lagging
+        rejoiners, roll out a new version when one is complete."""
+        if not self._seeded and not self.seed_committed():
+            return
+        self.heal_lagging()
+        target = self.target_version()
+        if target is not None:
+            self.rollout(target)
+
+    def heal_lagging(self):
+        """Bring a healthy replica serving an OLD version (a rejoiner
+        that restarted mid-rollout and booted off its local disk) up to
+        the fleet's committed version: prepare, then commit once ready.
+        No gate needed — a lagging replica is not routable until its
+        serving version matches, so its flip cannot mix versions."""
+        committed = self.committed_version
+        for addr, version in sorted(
+                self.state.serving_versions().items()):
+            if version >= committed:
+                continue
+            try:
+                http_post_json(addr, "/fleet/prepare",
+                               {"version": committed},
+                               self.http_timeout)
+                if self._replica_ready(addr, committed):
+                    result = http_post_json(
+                        addr, "/fleet/commit", {"version": committed},
+                        self.http_timeout)
+                    if self._commit_ok(result):
+                        self.state.note_committed(addr, committed)
+                        self.state.bump("router.healed_replicas")
+                    logger.info("healed replica %s to committed "
+                                "version %d: %s", addr, committed,
+                                result)
+            except Exception as e:  # noqa: BLE001 — a replica that
+                # dies mid-heal is just ejected again by the prober
+                logger.warning("healing %s to version %d failed: %s",
+                               addr, committed, e)
+
+    @staticmethod
+    def _commit_ok(result):
+        """A replica's /fleet/commit reply: every hosted model must
+        have taken the version."""
+        return bool(result) and all(
+            model.get("committed") for model in result.values())
+
+    def _replica_ready(self, addr, version):
+        """True once the replica reports ``version`` warm (prepared) or
+        already serving."""
+        state = http_get_json(addr, "/fleet/state", self.http_timeout)
+        for model_state in state.get("models", {}).values():
+            ready = (model_state.get("serving", 0) >= version
+                     or model_state.get("prepared") == version)
+            if not ready:
+                return False
+        return bool(state.get("models"))
+
+    def rollout(self, target):
+        """The no-mixed-version hot-swap: pre-warm everywhere, wait for
+        all-N-ready, then flip atomically behind the admission gate."""
+        logger.info("fleet rollout: version %d -> %d",
+                    self.committed_version, target)
+        deadline = time.monotonic() + self.barrier_timeout
+        prepared = set()
+        while True:
+            barrier = self.state.barrier_set()
+            if not barrier:
+                logger.warning("rollout of %d abandoned: no healthy "
+                               "replicas", target)
+                return False
+            pending = []
+            for addr in barrier:
+                try:
+                    if addr not in prepared:
+                        http_post_json(addr, "/fleet/prepare",
+                                       {"version": target},
+                                       self.http_timeout)
+                        prepared.add(addr)
+                    if not self._replica_ready(addr, target):
+                        pending.append(addr)
+                except Exception as e:  # noqa: BLE001 — replica died
+                    # mid-prepare; the prober will eject it and the
+                    # barrier set shrinks on the next pass
+                    logger.warning("prepare of %d on %s failed: %s",
+                                   target, addr, e)
+                    pending.append(addr)
+            if not pending:
+                break
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    "rollout of %d abandoned: %s not ready within "
+                    "%.0fs (will retry next scan)", target,
+                    sorted(pending), self.barrier_timeout)
+                return False
+            time.sleep(self.ready_poll_secs)
+        return self._commit_barrier(target)
+
+    def _commit_barrier(self, target):
+        """All replicas warm: close the admission gate, drain in-flight
+        forwards, commit everywhere, flip, reopen.  The gate pause is
+        milliseconds (commit publishes an already-warm model)."""
+        if self.gate is not None:
+            self.gate.close()
+        try:
+            if self.gate is not None and not self.gate.wait_idle(
+                    self.barrier_timeout):
+                logger.warning("rollout of %d: in-flight forwards did "
+                               "not drain; flipping anyway after "
+                               "timeout", target)
+            committed_somewhere = False
+            for addr in self.state.barrier_set():
+                try:
+                    result = http_post_json(
+                        addr, "/fleet/commit", {"version": target},
+                        self.http_timeout)
+                    if self._commit_ok(result):
+                        committed_somewhere = True
+                        self.state.note_committed(addr, target)
+                    logger.info("commit %d on %s: %s", target, addr,
+                                result)
+                except Exception as e:  # noqa: BLE001 — replica died
+                    # at the worst moment: eject; it heals on rejoin
+                    logger.warning("commit of %d on %s failed: %s",
+                                   target, addr, e)
+                    self.state.note_forward_failure(
+                        addr, time.monotonic())
+            if not committed_somewhere:
+                logger.warning("rollout of %d aborted at commit: no "
+                               "replica accepted", target)
+                return False
+            self.committed_version = target
+            self.state.bump("router.rollouts")
+        finally:
+            if self.gate is not None:
+                self.gate.open()
+        logger.info("fleet committed version is now %d", target)
+        return True

@@ -14,7 +14,26 @@ import os
 import numpy as np
 
 
-def resolve_export_dir(path):
+def list_versions(path):
+    """COMPLETE numeric versions under a TF-Serving-style base
+    (``path/<N>/`` with a manifest.json — the exporter writes the
+    manifest last, so its presence marks a finished export), sorted
+    ascending.  Empty when ``path`` is a direct export dir or holds no
+    complete version."""
+    if os.path.isfile(os.path.join(path, "manifest.json")):
+        return []
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        entries = []
+    return sorted(
+        int(entry) for entry in entries
+        if entry.isdigit() and os.path.isfile(
+            os.path.join(path, entry, "manifest.json"))
+    )
+
+
+def resolve_export_dir(path, version=None):
     """Accept either a direct export dir or a TF-Serving-style
     versioned base (``path/<N>/`` numeric subdirs): return the dir
     holding the highest COMPLETE version (manifest.json present — the
@@ -22,25 +41,27 @@ def resolve_export_dir(path):
     ``serving.export`` deliberately defers to it rather than keeping a
     second copy (see the comment there), and this file stays
     framework-import-free so it can be vendored into a serving process
-    alone."""
-    if os.path.isfile(os.path.join(path, "manifest.json")):
+    alone.
+
+    ``version`` pins the scan to ONE version instead of the latest —
+    the fleet coordinator's barrier protocol needs a replica to load
+    exactly the version the fleet agreed on, not whatever its local
+    disk happens to hold newest (docs/serving.md fleet section)."""
+    if version is None and os.path.isfile(
+            os.path.join(path, "manifest.json")):
         return path
-    best = None
-    try:
-        entries = os.listdir(path)
-    except OSError:
-        entries = []
-    for entry in entries:
-        sub = os.path.join(path, entry)
-        if (entry.isdigit()
-                and os.path.isfile(os.path.join(sub, "manifest.json"))
-                and (best is None or int(entry) > best[0])):
-            best = (int(entry), sub)
-    if best is None:
+    if version is not None:
+        sub = os.path.join(path, str(int(version)))
+        if os.path.isfile(os.path.join(sub, "manifest.json")):
+            return sub
+        raise FileNotFoundError(
+            "no complete version %s under %r" % (version, path))
+    versions = list_versions(path)
+    if not versions:
         raise FileNotFoundError(
             "no manifest.json in %r and no complete numeric version "
             "subdirectory under it" % path)
-    return best[1]
+    return os.path.join(path, str(versions[-1]))
 
 
 class ServableModel:

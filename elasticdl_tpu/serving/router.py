@@ -1,0 +1,445 @@
+"""Serving-fleet router: one front door over N replicated model servers.
+
+PR 3 made ONE server fast (dynamic batching); this spreads
+``:predict``/``:lookup`` over a fleet of them — the missing piece of
+"serving heavy traffic from millions of users" (ROADMAP item 4).  The
+router is a stdlib-only HTTP process (the same ThreadingHTTPServer
+discipline as the model server) that owns three jobs:
+
+ - **Routing.**  Requests carrying a key (an ``X-Routing-Key`` header,
+   a ``routing_key`` JSON field, or — for ``:lookup`` — the embedding
+   table name) are placed by RENDEZVOUS (highest-random-weight)
+   hashing over the routable replicas: adding or removing a replica
+   moves only ~1/N of the keyspace (tests pin this), which is what
+   keeps the replicas' hot-row embedding caches warm through churn.
+   Keyless requests fall back to LEAST-LOADED: the router's own live
+   in-flight count per replica first (exact and instant), then the
+   probed queue-wait / occupancy from each replica's ``/statz``.
+
+ - **Health.**  A prober thread (serving/fleet.py) polls every
+   replica's ``/statz``; a miss — or a failed live forward — EJECTS
+   the replica, and jittered-backoff probes ride it back in.  A
+   forward that fails on a dead socket is retried on a surviving
+   replica EXACTLY ONCE (the retry is re-keyed over the survivors, so
+   rendezvous keys fail over deterministically).
+
+ - **Fleet hot-swap.**  The embedded FleetCoordinator rolls new export
+   versions out with no mixed-version window: pre-warm everywhere,
+   all-N-ready, then flip behind this router's admission gate
+   (serving/fleet.py has the full protocol).  Responses carry the
+   ``model_version`` that served them, so version purity is checkable
+   from the outside — the bench drills do exactly that.
+
+Observability: ``/statz`` (fleet JSON), ``/metrics`` (Prometheus, the
+master status-server convention), ``/fleet/status`` (committed version
++ per-replica view — also what a rejoining replica's operator reads
+instead of trusting its local disk scan).
+
+Run:
+  python -m elasticdl_tpu.serving.router --replicas h:p,h:p,...
+      [--export_dir BASE] [--port 8500] [--probe_interval 0.5]
+"""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from elasticdl_tpu.master.status_server import fleet_to_prometheus
+from elasticdl_tpu.serving.fleet import (
+    FleetCoordinator,
+    FleetState,
+    HealthProber,
+    pick_replica,
+    rendezvous_rank,
+)
+from elasticdl_tpu.utils.args import build_router_parser
+from elasticdl_tpu.utils.logging import get_logger
+
+__all__ = [
+    "AdmissionGate", "Router", "build_router_server", "main",
+    "pick_replica", "rendezvous_rank",
+]
+
+logger = get_logger(__name__)
+
+# Transport-level failures worth one failover retry: the replica died
+# or went away mid-request.  HTTP status codes are NOT here — a 4xx/5xx
+# is a replica ANSWERING, and replaying a request the replica may have
+# half-executed is the client's call, not the router's.
+_FORWARD_ERRORS = (ConnectionError, TimeoutError, OSError,
+                   http.client.HTTPException)
+
+
+class AdmissionGate:
+    """The router's version-flip barrier: normally open (requests pass
+    with one Event check), closed for the milliseconds of a fleet
+    commit so stale-version requests DRAIN instead of interleaving
+    with the new version.  Entering is (gate check + in-flight
+    increment) atomically under the lock, so ``wait_idle`` can never
+    miss a request that slipped past a closing gate."""
+
+    def __init__(self):
+        self._open = threading.Event()
+        self._open.set()
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def enter(self, timeout=10.0):
+        """True = admitted (caller MUST pair with ``exit_``); False =
+        the gate stayed closed for ``timeout`` (reply 503)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._open.wait(remaining):
+                return False
+            with self._lock:
+                if self._open.is_set():
+                    self._inflight += 1
+                    return True
+            # closed between wait() and the lock — wait again
+
+    def exit_(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def inflight(self):
+        with self._lock:
+            return self._inflight
+
+    @property
+    def is_open(self):
+        return self._open.is_set()
+
+    def close(self):
+        with self._lock:
+            self._open.clear()
+
+    def open(self):
+        self._open.set()
+
+    def wait_idle(self, timeout):
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.inflight() <= 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
+
+class _ConnPool:
+    """Keep-alive connections to ONE replica.  http.client connections
+    are not thread-safe, so each is used by one request at a time:
+    acquire pops an idle one (or dials), release returns it.  Anything
+    suspect — error, close header — is closed, not pooled."""
+
+    def __init__(self, addr, timeout, max_idle=8):
+        host, _, port = addr.rpartition(":")
+        self._host = host or addr
+        self._port = int(port)
+        self._timeout = timeout
+        self._max_idle = max_idle
+        self._lock = threading.Lock()
+        self._idle = []
+
+    def acquire(self):
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self._timeout)
+
+    def release(self, conn, reusable):
+        if reusable:
+            with self._lock:
+                if len(self._idle) < self._max_idle:
+                    self._idle.append(conn)
+                    return
+        try:
+            conn.close()
+        except Exception:  # noqa: BLE001 — already broken
+            pass
+
+    def clear(self):
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class Router:
+    """Routing + forwarding engine; build_router_server wraps it in the
+    HTTP front end and main() adds the prober/rollout threads."""
+
+    def __init__(self, replica_addrs, export_dir="",
+                 probe_interval=0.5, probe_timeout=2.0,
+                 request_timeout=60.0, barrier_timeout=120.0,
+                 poll_interval=2.0):
+        self.state = FleetState(replica_addrs,
+                                probe_interval=probe_interval)
+        self.gate = AdmissionGate()
+        self.prober = HealthProber(self.state,
+                                   probe_timeout=probe_timeout)
+        self.coordinator = FleetCoordinator(
+            self.state, export_dir, gate=self.gate,
+            http_timeout=probe_timeout,
+            barrier_timeout=barrier_timeout)
+        self.poll_interval = poll_interval
+        self.request_timeout = request_timeout
+        # Routing-only mode (no export base to scan): there is no
+        # committed version to pin routing to — any healthy replica is
+        # routable, whatever it serves.  With coordination ON, routing
+        # is version-pinned to the coordinator's committed version.
+        self.coordinating = bool(export_dir)
+        self._pools = {addr: _ConnPool(addr, request_timeout)
+                       for addr in replica_addrs}
+        self._stop = threading.Event()
+        self._rollout_thread = threading.Thread(
+            target=self._rollout_loop, daemon=True,
+            name="fleet-rollout")
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, coordinate=None):
+        if coordinate is not None:
+            self.coordinating = bool(coordinate)
+        self.prober.start()
+        if self.coordinating:
+            self._rollout_thread.start()
+
+    def committed_view(self):
+        """The version routing pins to: the coordinator's committed
+        version, or None in routing-only mode (no version discipline
+        to enforce — the operator owns replica versions)."""
+        return (self.coordinator.committed_version
+                if self.coordinating else None)
+
+    def stop(self):
+        self._stop.set()
+        self.prober.stop()
+        if self._rollout_thread.is_alive():
+            self._rollout_thread.join(timeout=5)
+        for pool in self._pools.values():
+            pool.clear()
+
+    def _rollout_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.coordinator.tick()
+            except Exception as e:  # noqa: BLE001 — a failed scan or
+                # rollout attempt must not kill the coordinator; the
+                # next tick retries
+                logger.warning("fleet tick failed: %s", e)
+            self._stop.wait(self.poll_interval)
+
+    # -- routing -------------------------------------------------------
+
+    @staticmethod
+    def routing_key(path, headers, body):
+        """The affinity key, if the request has one: explicit header,
+        explicit JSON field, else the embedding table for lookups
+        (keeps one table's hot rows in ONE replica's cache).  Predicts
+        without a key are stateless — load balance them instead."""
+        key = headers.get("X-Routing-Key")
+        if key:
+            return key
+        if isinstance(body, dict):
+            if body.get("routing_key"):
+                return str(body["routing_key"])
+            if path.endswith(":lookup") and "table" in body:
+                return "table:%s" % body["table"]
+        return None
+
+    def forward(self, method, path, raw_body, key=None):
+        """Forward one request; returns (status, body_bytes,
+        content_type, replica_addr).  A transport-level failure ejects
+        the replica and retries on a survivor exactly once.  Replica
+        selection (``FleetState.acquire``) counts the forward in-flight
+        atomically with the pick, so concurrent keyless requests
+        spread instead of herding onto one momentarily-idle replica."""
+        attempts = 0
+        exclude = []
+        while True:
+            committed = self.committed_view()
+            addr = self.state.acquire(committed, key=key,
+                                      exclude=exclude)
+            if addr is None:
+                self.state.bump("router.no_replica")
+                return 503, json.dumps(
+                    {"error": "no routable replica (healthy%s)"
+                              % ("" if committed is None else
+                                 " and at committed version %d"
+                                 % committed)}
+                ).encode(), "application/json", None
+            try:
+                return self._forward_to(addr, method, path, raw_body)
+            except _FORWARD_ERRORS as e:
+                self.state.note_forward_failure(addr, time.monotonic())
+                self._pools[addr].clear()
+                attempts += 1
+                exclude.append(addr)
+                if attempts > 1:
+                    self.state.bump("router.forward_failed")
+                    return 502, json.dumps(
+                        {"error": "replicas %s failed: %s"
+                                  % (exclude, e)}
+                    ).encode(), "application/json", None
+                self.state.bump("router.retried_requests")
+                logger.warning("forward to %s failed (%s); retrying "
+                               "once on a survivor", addr, e)
+            finally:
+                self.state.forward_finished(addr)
+
+    def _forward_to(self, addr, method, path, raw_body):
+        pool = self._pools[addr]
+        conn = pool.acquire()
+        reusable = False
+        try:
+            headers = {}
+            if raw_body is not None:
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=raw_body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            content_type = resp.getheader("Content-Type",
+                                          "application/json")
+            reusable = (resp.getheader("Connection", "")
+                        .lower() != "close")
+            self.state.bump("router.forwarded")
+            return resp.status, payload, content_type, addr
+        finally:
+            pool.release(conn, reusable)
+
+    # -- observability -------------------------------------------------
+
+    def fleet_status(self):
+        replicas, counters = self.state.snapshot()
+        return {
+            "committed_version": self.coordinator.committed_version,
+            "coordinating": self.coordinating,
+            "replicas": replicas,
+            "counters": counters,
+            "gate_open": self.gate.is_open,
+        }
+
+
+def build_router_server(router, port=0, host="127.0.0.1",
+                        gate_timeout=10.0):
+    """HTTP front end over a :class:`Router`.  POSTs under /v1/ (and
+    /fleet-prefixed GETs the router answers itself) — everything else
+    under /v1/ forwards too, so TF-Serving metadata GETs keep working
+    through the fleet."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive toward clients,
+        # same discipline (and Content-Length guarantee) as the model
+        # server's handler
+
+        def log_message(self, fmt, *args):
+            logger.debug("router: " + fmt, *args)
+
+        def _reply_raw(self, code, body, content_type):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code, payload):
+            self._reply_raw(code, json.dumps(payload).encode(),
+                            "application/json")
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._reply_json(200, {"status": "ok"})
+            if self.path in ("/statz", "/fleet/status"):
+                return self._reply_json(200, router.fleet_status())
+            if self.path == "/metrics":
+                return self._reply_raw(
+                    200,
+                    fleet_to_prometheus(router.fleet_status()).encode(),
+                    "text/plain; version=0.0.4")
+            if self.path.startswith("/v1/"):
+                status, body, content_type, _ = router.forward(
+                    "GET", self.path, None)
+                return self._reply_raw(status, body, content_type)
+            self._reply_json(404, {"error": "unknown path %r"
+                                            % self.path})
+
+        def do_POST(self):
+            if self.headers.get("Transfer-Encoding") or (
+                    "Content-Length" not in self.headers):
+                self.close_connection = True
+                return self._reply_json(
+                    411, {"error": "Content-Length required"})
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)
+            if not self.path.startswith("/v1/"):
+                return self._reply_json(
+                    404, {"error": "unknown path %r" % self.path})
+            key = None
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    body = None  # replica will 400 it; no key
+                key = Router.routing_key(self.path, self.headers,
+                                         body)
+            # The version-flip barrier: requests admitted here are
+            # drained before a fleet commit flips routing.
+            if not router.gate.enter(timeout=gate_timeout):
+                return self._reply_json(
+                    503, {"error": "fleet version flip in progress"})
+            try:
+                status, payload, content_type, _ = router.forward(
+                    "POST", self.path, raw, key=key)
+                self._reply_raw(status, payload, content_type)
+            finally:
+                router.gate.exit_()
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.router = router
+    return server
+
+
+def main(argv=None):
+    args = build_router_parser().parse_args(argv)
+    replicas = [a.strip() for a in args.replicas.split(",")
+                if a.strip()]
+    if not replicas:
+        raise SystemExit("--replicas must name at least one "
+                         "host:port")
+    router = Router(
+        replicas, export_dir=args.export_dir,
+        probe_interval=args.probe_interval,
+        probe_timeout=args.probe_timeout,
+        request_timeout=args.request_timeout,
+        barrier_timeout=args.barrier_timeout,
+        poll_interval=args.poll_interval,
+    )
+    server = build_router_server(router, port=args.port,
+                                 host=args.host)
+    router.start()
+    logger.info(
+        "fleet router on %s:%d over %d replica(s) %s (rollout "
+        "coordination: %s)", args.host, server.server_address[1],
+        len(replicas), replicas,
+        "on, scanning %s" % args.export_dir if args.export_dir
+        else "off")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

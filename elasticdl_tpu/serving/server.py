@@ -9,11 +9,32 @@ request shape:
 
   GET  /v1/models/<name>            -> model metadata (manifest)
   GET  /statz                       -> batching/queue/latency counters
-  POST /v1/models/<name>:predict    -> {"predictions": [...]}
-       body {"instances": [...]}          batched single-input models
+  GET  /metrics                     -> the serving counters in
+                                       Prometheus text format (the
+                                       master status-server convention)
+  GET  /fleet/state                 -> per-model serving/prepared
+                                       versions (fleet barrier protocol)
+  POST /fleet/prepare {"version"}   -> background-load + warm a version
+  POST /fleet/commit  {"version"}   -> atomically publish a prepared one
+  POST /v1/models/<name>:predict    -> {"predictions": [...],
+       body {"instances": [...]}        "model_version": v}
        body {"inputs": {name: [...]}}     dict-input models
-  POST /v1/models/<name>:lookup     -> {"vectors": [...]}
-       body {"table": t, "ids": [...]}    PS-trained embedding tables
+  POST /v1/models/<name>:lookup     -> {"vectors": [...],
+       body {"table": t, "ids": [...]}    "model_version": v}
+
+Predict/lookup responses carry the ``model_version`` that actually
+served them — the fleet router and its drills verify version purity
+across a hot-swap from exactly this stamp.
+
+``:lookup`` resolves from the export's embedded tables, or — when the
+server is armed with ``--ps_addrs`` — from the TRAINING parameter
+servers through the PS-backed shared embedding service
+(serving/embedding_service.py): tables larger than one server's RAM
+serve from where they live, fronted by a byte-budgeted hot-row LRU.
+
+On SIGTERM the server DRAINS instead of dropping connections: new
+requests get 503 + ``Connection: close`` (so the router's health probe
+ejects the replica), in-flight batches finish, then the process exits.
 
 Stdlib-only HTTP (ThreadingHTTPServer, HTTP/1.1 keep-alive); jax is
 needed only to execute the StableHLO — the loader stays framework-free.
@@ -30,6 +51,7 @@ Run: python -m elasticdl_tpu.serving.server --export_dir D [--port P]
 
 import json
 import os
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -46,6 +68,7 @@ from elasticdl_tpu.serving.loader import (
     load_servable,
     resolve_export_dir,
 )
+from elasticdl_tpu.master.status_server import serving_to_prometheus
 from elasticdl_tpu.utils.args import build_serving_parser
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.timing import Timing
@@ -94,9 +117,21 @@ class ModelEndpoint:
     """
 
     def __init__(self, export_dir, name=None, poll_interval=2.0,
-                 batching=None):
+                 batching=None, fleet_managed=False,
+                 embedding_service=None):
         self.export_dir = export_dir
         self.poll_interval = poll_interval
+        # Fleet-managed replicas NEVER self-swap from a local disk scan:
+        # version changes arrive only through the coordinator's
+        # prepare/commit barrier (serving/fleet.py), so a replica
+        # rejoining mid-rollout cannot regress — or race ahead of — the
+        # fleet's committed version just because of what its local
+        # export dir happens to hold.
+        self.fleet_managed = bool(fleet_managed)
+        # PS-backed embedding lookups (embedding_service.py); one
+        # service per endpoint — its cache is keyed by THIS model's
+        # version, re-keyed on every publish.
+        self._embedding_service = embedding_service
         self.model = load_servable(export_dir)
         # Versioned mode iff the base itself is not a direct export —
         # then the loader resolved a numeric subdir we can re-scan.
@@ -128,6 +163,15 @@ class ModelEndpoint:
         # section — never held during predict execution
         self._batcher = None
         self._reload_thread = None
+        # Fleet barrier slots, all guarded by _reload_lock: the version
+        # being background-prepared, the warm prepared servable waiting
+        # for its commit, and the last prepare failure.
+        self._preparing = None
+        self._prepared = None          # (version, model, dtypes, plan)
+        self._prepare_error = None
+        self._prepare_thread = None
+        if self._embedding_service is not None:
+            self._embedding_service.set_version(self.serving_version())
         if self._batching is not None:
             self._warm_buckets(self.model, plan)
             self._batcher = ModelBatcher(
@@ -188,6 +232,8 @@ class ModelEndpoint:
         thread and the new version publishes (atomically, warm) when
         ready; in-flight and in-queue requests finish on the model
         they were admitted under either way."""
+        if self.fleet_managed:
+            return  # version changes only via prepare/commit barrier
         if not self._versioned:
             return
         if time.monotonic() - self._last_scan < self.poll_interval:
@@ -239,9 +285,127 @@ class ModelEndpoint:
                 self._dtypes = dtypes
                 self._active = (fresh, dtypes, plan)
                 self._loaded_dir = fresh.export_dir
+        if self._embedding_service is not None:
+            self._embedding_service.set_version(
+                fresh.manifest.get("version", 0))
         logger.info("reloaded model %r from %s (version %s)",
                     self.name, fresh.export_dir,
                     fresh.manifest.get("version"))
+
+    # -- fleet hot-swap barrier (serving/fleet.py drives these) ---------
+
+    def serving_version(self):
+        """Version of the model CURRENTLY serving traffic."""
+        return int(self._snapshot()[0].manifest.get("version", 0) or 0)
+
+    def prepare_version(self, version):
+        """Background-load + warm export version ``version`` without
+        publishing it (phase 1 of the fleet barrier): traffic keeps
+        hitting the warm serving model while the incoming version
+        compiles its pad buckets.  Idempotent; returns the fleet-state
+        dict so the coordinator can poll readiness off the reply."""
+        version = int(version)
+        start = False
+        with self._reload_lock:
+            already = (
+                self.serving_version() >= version
+                or (self._prepared is not None
+                    and self._prepared[0] == version)
+                or (self._preparing == version
+                    and self._prepare_thread is not None
+                    and self._prepare_thread.is_alive())
+            )
+            if not already:
+                self._preparing = version
+                self._prepare_error = None
+                thread = threading.Thread(
+                    target=self._prepare_worker, args=(version,),
+                    daemon=True, name="prepare-%s" % self.name)
+                self._prepare_thread = thread
+                start = True
+        if start:
+            thread.start()
+        return self.fleet_state()
+
+    def _prepare_worker(self, version):
+        """Load + warm one pinned version; park it in the prepared
+        slot.  Runs OUTSIDE the reload lock — only the slot update
+        takes it — so a fleet prepare never stalls /fleet/state polls
+        or (non-fleet) scan-and-swap behind an XLA warmup."""
+        try:
+            resolved = resolve_export_dir(self.export_dir,
+                                          version=version)
+            fresh = load_servable(resolved)
+            dtypes = _leaf_dtypes(
+                fresh.manifest.get("input_signature", {}))
+            plan = (batch_plan(fresh.manifest)
+                    if self._batching is not None else None)
+            self._warm_buckets(fresh, plan)
+        except Exception as e:  # noqa: BLE001 — a bad/missing export
+            # must surface on /fleet/state, not kill the thread silently
+            logger.warning("prepare of version %d failed: %s",
+                           version, e)
+            with self._reload_lock:
+                if self._preparing == version:
+                    self._prepare_error = "%s: %s" % (
+                        type(e).__name__, e)
+                    self._preparing = None
+            return
+        with self._reload_lock:
+            if self._preparing == version:
+                self._prepared = (version, fresh, dtypes, plan)
+                self._preparing = None
+
+    def commit_version(self, version):
+        """Phase 2 of the fleet barrier: atomically publish a PREPARED
+        version.  Refuses a version below the one already serving — a
+        coordinator healing a rejoined replica can therefore never
+        regress it — and refuses an un-prepared version (the
+        coordinator re-prepares and retries).  In-queue requests
+        admitted before the flip finish on the model they were
+        marshalled against (the batcher's version purity): stale-version
+        traffic drains, it never mixes."""
+        version = int(version)
+        with self._reload_lock:
+            serving = self.serving_version()
+            if serving == version:
+                return {"committed": True, "serving": serving}
+            if version < serving:
+                return {"committed": False, "serving": serving,
+                        "error": "version %d would regress serving "
+                                 "version %d" % (version, serving)}
+            if self._prepared is None or self._prepared[0] != version:
+                return {"committed": False, "serving": serving,
+                        "error": "version %d not prepared" % version}
+            _, fresh, dtypes, plan = self._prepared
+            self._prepared = None
+            with self._lock:
+                self.model = fresh
+                self._dtypes = dtypes
+                self._active = (fresh, dtypes, plan)
+                self._loaded_dir = fresh.export_dir
+        if self._embedding_service is not None:
+            # Version-keyed cache invalidation: PS-backed rows never
+            # survive a version flip (docs/serving.md fleet section).
+            self._embedding_service.set_version(version)
+        logger.info("fleet commit: model %r now serving version %d",
+                    self.name, version)
+        return {"committed": True, "serving": version}
+
+    def fleet_state(self):
+        """Barrier-protocol view: what this replica serves, what it has
+        warm and ready, what it is still preparing."""
+        with self._reload_lock:
+            prepared = (self._prepared[0] if self._prepared is not None
+                        else None)
+            preparing = self._preparing
+            error = self._prepare_error
+        return {
+            "serving": self.serving_version(),
+            "prepared": prepared,
+            "preparing": preparing,
+            "error": error,
+        }
 
     def metadata(self):
         self.maybe_reload()
@@ -260,7 +424,7 @@ class ModelEndpoint:
         model = self._snapshot()[0]
         counters = self.timing.counters()
         batches = counters.get("batcher.batches", 0)
-        return {
+        out = {
             "model": self.name,
             "version": model.manifest.get("version", 0),
             "batching": (self._batching.describe()
@@ -271,6 +435,9 @@ class ModelEndpoint:
                 counters.get("batcher.rows", 0) / batches
                 if batches else None),
         }
+        if self._embedding_service is not None:
+            out["emb_cache"] = self._embedding_service.stats()
+        return out
 
     def predict(self, body):
         if self._batcher is None:
@@ -295,26 +462,133 @@ class ModelEndpoint:
         else:
             with self._lock:
                 outputs = model.predict(inputs)
-        return {"predictions": _jsonable(outputs)}
+        # The version stamp is read from the SAME snapshot the request
+        # executed against (batches never mix models), so the fleet
+        # router's drills can assert version purity from responses.
+        return {"predictions": _jsonable(outputs),
+                "model_version": int(model.manifest.get("version", 0)
+                                     or 0)}
 
     def lookup(self, body):
         if self._batcher is None:
             self.maybe_reload()
         model = self._snapshot()[0]
+        table = body["table"]
         ids = np.asarray(body["ids"], np.int64)
+        version = int(model.manifest.get("version", 0) or 0)
+        if self._embedding_service is not None and (
+                body.get("source") == "ps"
+                or table not in model.embeddings):
+            # PS-backed shared embedding service: the table serves from
+            # the training PS shards (it may never have been exported at
+            # all), fronted by the per-model hot-row cache.  Network-
+            # bound, touches no model state — so it runs on the request
+            # thread, concurrent, never convoying device batches behind
+            # a PS round trip on the executor.
+            vectors = self._embedding_service.lookup(table, ids)
+            return {"vectors": vectors.tolist(),
+                    "model_version": version, "source": "ps"}
         if self._batcher is not None:
             # Same admission queue as predicts: a lookup executes on
             # ONE model snapshot, never racing a hot-swap mid-read.
-            vectors = self._batcher.lookup(model, body["table"], ids)
+            vectors = self._batcher.lookup(model, table, ids)
         else:
-            vectors = model.lookup_embedding(body["table"], ids)
-        return {"vectors": vectors.tolist()}
+            vectors = model.lookup_embedding(table, ids)
+        return {"vectors": vectors.tolist(), "model_version": version,
+                "source": "export"}
 
 
-def build_server(endpoints, port=0, host="127.0.0.1"):
+class DrainController:
+    """Graceful-drain state for one serving process.
+
+    On SIGTERM (``begin``) the replica stops ADMITTING: new POSTs get
+    503 + ``Connection: close`` so the router's health probe ejects it
+    and keep-alive clients reconnect elsewhere, while every already-
+    admitted request — including whole in-queue batches — runs to
+    completion (``wait_idle``).  The HTTP server only shuts down once
+    the in-flight count hits zero (or the grace budget runs out), so a
+    SIGTERM never drops a request mid-batch the way a bare process exit
+    did."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._draining = threading.Event()
+
+    @property
+    def draining(self):
+        return self._draining.is_set()
+
+    def begin(self):
+        self._draining.set()
+
+    def admit(self):
+        """True = request admitted (caller MUST pair with done());
+        False = draining, reply 503."""
+        with self._lock:
+            if self._draining.is_set():
+                return False
+            self._inflight += 1
+            return True
+
+    def done(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def inflight(self):
+        with self._lock:
+            return self._inflight
+
+    def wait_idle(self, timeout):
+        """Poll until every admitted request finished; True on idle,
+        False when the grace budget ran out first."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.inflight() <= 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+
+def install_drain_handler(server, endpoints, drain, grace_secs=10.0):
+    """Arm SIGTERM for graceful drain (main-thread only — the signal
+    module's constraint): stop admitting, let in-flight batches finish,
+    then stop the HTTP server and the batcher executors."""
+
+    def drain_and_stop():
+        logger.info("SIGTERM: draining (%d in flight, grace %.1fs)",
+                    drain.inflight(), grace_secs)
+        idle = drain.wait_idle(grace_secs)
+        if not idle:
+            logger.warning("drain grace expired with %d in flight",
+                           drain.inflight())
+        server.shutdown()
+        # Close the LISTENING socket immediately: a late client must
+        # get connection-refused (clean, instantly retryable
+        # elsewhere), not a connection the dead serve loop will never
+        # answer.  serve_forever's own server_close is a no-op after
+        # this.
+        server.server_close()
+        for endpoint in endpoints:
+            endpoint.close()
+
+    def on_sigterm(_signum, _frame):
+        drain.begin()
+        # The actual wait runs off the signal frame: a handler must
+        # not block (it may have interrupted arbitrary code).
+        threading.Thread(target=drain_and_stop, daemon=True,
+                         name="drain").start()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+
+def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
     """``endpoints``: one ModelEndpoint or a list — the TF-Serving
     model-config role: one server process hosts several models, each
-    under its own /v1/models/<name> tree."""
+    under its own /v1/models/<name> tree.  ``drain``: a
+    :class:`DrainController`; one is built when omitted and exposed as
+    ``server.drain``."""
     if isinstance(endpoints, ModelEndpoint):
         endpoints = [endpoints]
     by_name = {e.name: e for e in endpoints}
@@ -322,6 +596,7 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
         raise ValueError(
             "duplicate model names: %s"
             % sorted(e.name for e in endpoints))
+    drain = drain if drain is not None else DrainController()
 
     # Routing tables built ONCE: O(1) dispatch per request.
     get_paths = {}
@@ -346,25 +621,62 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("http: " + fmt, *args)
 
-        def _reply(self, code, payload):
+        def _reply(self, code, payload, close=False):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if close:
+                # Advertise the close so keep-alive clients (and the
+                # router's connection pool) re-connect elsewhere
+                # instead of finding a dead socket mid-request later.
+                self.send_header("Connection", "close")
+                self.close_connection = True
             self.end_headers()
             self.wfile.write(body)
+
+        def _reply_text(self, code, text, content_type):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _statz(self):
+            return {
+                "draining": drain.draining,
+                "models": {name: endpoint.stats()
+                           for name, endpoint in by_name.items()},
+            }
 
         def do_GET(self):
             if self.path == "/healthz":
                 # liveness/readiness probe target (matches the
-                # master's and PS's observability surface)
+                # master's and PS's observability surface); a draining
+                # replica fails the probe so orchestrators and the
+                # router stop sending traffic before the socket dies.
+                if drain.draining:
+                    return self._reply(503, {"status": "draining"},
+                                       close=True)
                 return self._reply(200, {"status": "ok"})
             if self.path == "/statz":
                 # Batching observability: per-model batch occupancy,
-                # queue wait, execution time, flush reasons.
+                # queue wait, execution time, flush reasons — plus the
+                # drain flag the router's health probe keys on.
+                return self._reply(200, self._statz())
+            if self.path == "/metrics":
+                # The same numbers in Prometheus exposition format
+                # (master status-server convention), so the router and
+                # the fleet drills scrape one format everywhere.
+                return self._reply_text(
+                    200, serving_to_prometheus(self._statz()),
+                    "text/plain; version=0.0.4")
+            if self.path == "/fleet/state":
                 return self._reply(200, {
-                    name: endpoint.stats()
-                    for name, endpoint in by_name.items()
+                    "draining": drain.draining,
+                    "models": {name: endpoint.fleet_state()
+                               for name, endpoint in by_name.items()},
                 })
             handler = get_paths.get(self.path)
             if handler is not None:
@@ -390,12 +702,26 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
                 body = json.loads(self.rfile.read(length) or b"{}")
             except ValueError as e:
                 return self._reply(400, {"error": "bad JSON: %s" % e})
-            route = post_routes.get(self.path)
-            if route is None:
-                return self._reply(
-                    404, {"error": "unknown path %r (models: %s)"
-                          % (self.path, sorted(by_name))})
+            if not drain.admit():
+                # Draining: refuse + close so the client's next request
+                # opens against a healthy replica (the router also
+                # ejects us off this signal / the failed probe).
+                return self._reply(503, {"error": "draining"},
+                                   close=True)
             try:
+                if self.path == "/fleet/prepare":
+                    return self._reply(200, {
+                        name: endpoint.prepare_version(body["version"])
+                        for name, endpoint in by_name.items()})
+                if self.path == "/fleet/commit":
+                    return self._reply(200, {
+                        name: endpoint.commit_version(body["version"])
+                        for name, endpoint in by_name.items()})
+                route = post_routes.get(self.path)
+                if route is None:
+                    return self._reply(
+                        404, {"error": "unknown path %r (models: %s)"
+                              % (self.path, sorted(by_name))})
                 self._reply(200, route(body))
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
@@ -405,8 +731,12 @@ def build_server(endpoints, port=0, host="127.0.0.1"):
                 logger.warning("request failed: %s", e)
                 self._reply(500, {"error": "%s: %s"
                                   % (type(e).__name__, e)})
+            finally:
+                drain.done()
 
-    return ThreadingHTTPServer((host, port), Handler)
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.drain = drain
+    return server
 
 
 def batch_config_from_args(args):
@@ -445,31 +775,66 @@ def main(argv=None):
         and p.partition("=")[2].strip() for p in pieces
     ) and ("=" in args.export_dir)
     batching = batch_config_from_args(args)
-    if is_multi and (len(pieces) > 1 or os.path.sep not in
-                     pieces[0].partition("=")[0]):
+    multi = is_multi and (len(pieces) > 1 or os.path.sep not in
+                          pieces[0].partition("=")[0])
+    n_models = len(pieces) if multi else 1
+
+    # PS-backed embedding lookups: ONE retry-armed PSClient per
+    # process (channels are shared), but one service PER MODEL — the
+    # hot-row cache is keyed by the model's OWN version counter, so
+    # model a's hot-swap can neither wipe nor permanently out-key
+    # model b's cache (version counters are independent per model).
+    # The byte budget splits evenly across models.
+    ps_client = None
+    if args.ps_addrs:
+        from elasticdl_tpu.utils.retry import ps_rpc_policy
+        from elasticdl_tpu.worker.ps_client import build_ps_client
+
+        ps_client = build_ps_client(args.ps_addrs,
+                                    retry=ps_rpc_policy())
+
+    def kwargs():
+        service = None
+        if ps_client is not None:
+            from elasticdl_tpu.serving.embedding_service import (
+                PSEmbeddingService,
+            )
+
+            service = PSEmbeddingService(
+                ps_client,
+                cache_bytes=int(args.emb_cache_mb * (1 << 20))
+                // n_models,
+            )
+        return dict(
+            poll_interval=args.poll_interval, batching=batching,
+            fleet_managed=args.fleet_managed,
+            embedding_service=service,
+        )
+
+    if multi:
         if args.model_name:
             logger.warning(
                 "--model_name %r ignored: the name=dir form names "
                 "each model explicitly", args.model_name)
         endpoints = [
             ModelEndpoint(p.partition("=")[2].strip(),
-                          name=p.partition("=")[0].strip(),
-                          poll_interval=args.poll_interval,
-                          batching=batching)
+                          name=p.partition("=")[0].strip(), **kwargs())
             for p in pieces
         ]
     else:
         endpoints = [ModelEndpoint(args.export_dir,
-                                   name=args.model_name,
-                                   poll_interval=args.poll_interval,
-                                   batching=batching)]
+                                   name=args.model_name, **kwargs())]
     server = build_server(endpoints, port=args.port, host=args.host)
+    install_drain_handler(server, endpoints, server.drain,
+                          grace_secs=args.drain_grace_secs)
     logger.info(
         "serving model(s) %s on %s:%d (predict: POST "
-        "/v1/models/<name>:predict; batching: %s)",
+        "/v1/models/<name>:predict; batching: %s; fleet_managed: %s; "
+        "ps_addrs: %s)",
         sorted(e.name for e in endpoints), args.host,
         server.server_address[1],
         batching.describe() if batching else "off",
+        args.fleet_managed, args.ps_addrs or "-",
     )
     try:
         server.serve_forever()

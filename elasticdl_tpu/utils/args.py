@@ -252,11 +252,84 @@ def add_serving_args(parser):
                         help="pre-compile every pad bucket at load and "
                              "hot-swap so no live request pays a cold "
                              "XLA compile")
+    parser.add_argument("--ps_addrs", default="",
+                        help="comma-separated TRAINING parameter-server "
+                             "addresses: :lookup for tables the export "
+                             "does not embed resolves against the live "
+                             "PS shards through the per-model hot-row "
+                             "cache (serving/embedding_service.py) — "
+                             "tables larger than one server's RAM "
+                             "serve from where they live (empty = "
+                             "export-embedded tables only)")
+    parser.add_argument("--emb_cache_mb", type=float, default=64.0,
+                        help="byte budget (MiB) for the PS-backed "
+                             "embedding hot-row LRU; version-keyed, "
+                             "invalidated on model hot-swap and PS "
+                             "restart-generation change; 0 disables "
+                             "caching (every lookup pays the PS round "
+                             "trip)")
+    parser.add_argument("--fleet_managed", type=_str2bool,
+                        default=False,
+                        help="replica runs under a fleet router "
+                             "(serving/router.py): local export-dir "
+                             "polling is DISABLED and version changes "
+                             "arrive only through the coordinator's "
+                             "/fleet/prepare + /fleet/commit barrier, "
+                             "so a replica rejoining mid-rollout can "
+                             "never regress the fleet's committed "
+                             "version off its own disk scan")
+    parser.add_argument("--drain_grace_secs", type=float, default=10.0,
+                        help="SIGTERM drain budget: the replica stops "
+                             "admitting (503 + Connection: close), "
+                             "lets in-flight batches finish up to this "
+                             "long, then exits")
 
 
 def build_serving_parser():
     parser = argparse.ArgumentParser("elasticdl_tpu.serving.server")
     add_serving_args(parser)
+    return parser
+
+
+def add_router_args(parser):
+    """Fleet-router flags (serving/router.py): N replicated model
+    servers behind one routing/hot-swap-coordination process."""
+    parser.add_argument("--replicas", required=True,
+                        help="comma-separated replica addresses "
+                             "(host:port of serving/server.py "
+                             "processes, --fleet_managed true)")
+    parser.add_argument("--export_dir", default="",
+                        help="versioned export base the fleet serves; "
+                             "the coordinator scans it for new "
+                             "complete versions and rolls them out "
+                             "fleet-wide (empty = no rollout "
+                             "coordination, routing only)")
+    parser.add_argument("--port", type=int, default=8500)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--probe_interval", type=float, default=0.5,
+                        help="seconds between /statz health probes of "
+                             "each replica (ejected replicas are "
+                             "re-probed with jittered backoff)")
+    parser.add_argument("--poll_interval", type=float, default=2.0,
+                        help="seconds between export-dir version scans "
+                             "(rollout cadence)")
+    parser.add_argument("--probe_timeout", type=float, default=2.0,
+                        help="health-probe HTTP timeout; a replica "
+                             "that misses one probe is ejected until "
+                             "a probe succeeds again")
+    parser.add_argument("--request_timeout", type=float, default=60.0,
+                        help="per-forward HTTP timeout toward a "
+                             "replica")
+    parser.add_argument("--barrier_timeout", type=float, default=120.0,
+                        help="max seconds to wait for every healthy "
+                             "replica to pre-warm an incoming version "
+                             "before the rollout attempt is abandoned "
+                             "and retried on the next scan")
+
+
+def build_router_parser():
+    parser = argparse.ArgumentParser("elasticdl_tpu.serving.router")
+    add_router_args(parser)
     return parser
 
 

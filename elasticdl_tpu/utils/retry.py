@@ -196,6 +196,19 @@ def master_rpc_policy(timing=None, deadline_secs=None):
     )
 
 
+def serving_probe_policy():
+    """Backoff schedule for the fleet router re-probing an EJECTED
+    serving replica (serving/fleet.py): jittered exponential from half
+    a second to ~10 s.  Only the delay math is used — no RPC rides this
+    policy — but reusing RetryPolicy keeps the jitter deterministic per
+    process and decorrelated from the other policies by name, like
+    every other backoff in the repo."""
+    return RetryPolicy(
+        name="serving_probe", max_attempts=1 << 30,
+        deadline_secs=None, base_delay_secs=0.5, max_delay_secs=10.0,
+    )
+
+
 def ps_rpc_policy(timing=None, deadline_secs=None):
     """The outage-riding policy for worker->PS RPCs: a SIGKILLed PS
     shard is relaunched-with-restore by the master's PSManager in

@@ -105,6 +105,15 @@ class Timing:
         }
         if zero1:
             out["zero1"] = zero1
+        # Serving embedding hot-row cache counters (hits/misses/
+        # evictions, serving/embedding_service.py), grouped the same
+        # way for /statz and bench consumers.
+        emb_cache = {
+            name: count for name, count in list(self._events.items())
+            if name.startswith("emb_cache.")
+        }
+        if emb_cache:
+            out["emb_cache"] = emb_cache
         return out
 
     def report(self):

@@ -360,11 +360,18 @@ class PSClient:
 
     # -- embeddings ---------------------------------------------------------
 
-    def pull_embedding_vectors(self, name, ids, dim=None):
+    def pull_embedding_vectors(self, name, ids, dim=None,
+                               read_only=False):
         """ids: int64 [n]; returns [n, dim] rows in input order.
 
         ``dim`` threads the table's row dim through for the empty-ids
-        case; omitted, it falls back to the infos this client pushed."""
+        case; omitted, it falls back to the infos this client pushed.
+
+        ``read_only`` is the serving-tier lookup mode: absent ids come
+        back as zero rows and are never lazily initialized on the PS
+        (docs/serving.md fleet section), and the response's generation
+        stamp keeps this client's restart-generation view current even
+        when it never touches the dense plane."""
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
             return np.zeros(
@@ -375,7 +382,8 @@ class PSClient:
         pending = {}
         for shard, positions in buckets.items():
             req = pb.PullEmbeddingVectorsRequest(
-                name=name, wire_dtype=self.wire_dtype or ""
+                name=name, wire_dtype=self.wire_dtype or "",
+                read_only=read_only,
             )
             # .tolist() keeps the proto extend in C instead of a
             # 300k-call python genexpr (profiled hot path).
@@ -392,6 +400,12 @@ class PSClient:
                     state) in pending.items():
             res = self._result(shard, "pull_embedding_vectors", rpc_fn,
                                req, future, state)
+            # Lookup responses carry the shard's restart generation
+            # (TensorPB.generation, 0 = pre-stamping server): an
+            # embedding-only client — the serving hot-row cache — must
+            # learn about a crash-restore rollback from the lookups
+            # themselves, not only from dense pulls it never issues.
+            self._note_generation(shard, res.generation)
             self._count_bytes("pull_embedding_bytes", res.ByteSize())
             rows = tensor_codec.pb_to_ndarray(res)
             if out is None:

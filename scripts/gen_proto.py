@@ -67,6 +67,16 @@ EDITS = [
      "generation"),
     ("ReportVersionRequest", "durable_version", 5, F.TYPE_INT32,
      "durableVersion"),
+    # Serving-tier PS-backed embedding lookups (docs/serving.md fleet
+    # section): read_only pulls never lazily initialize absent rows —
+    # serving traffic must not grow the training table — and the
+    # response TensorPB is stamped with the shard's restart generation
+    # so an embedding-only client (the serving hot-row cache) learns
+    # about a PS crash-restore rollback first-class from every lookup
+    # and can invalidate rows read from the dead incarnation.
+    ("PullEmbeddingVectorsRequest", "read_only", 4, F.TYPE_BOOL,
+     "readOnly"),
+    ("TensorPB", "generation", 5, F.TYPE_INT64, "generation"),
 ]
 
 

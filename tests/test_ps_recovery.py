@@ -386,18 +386,23 @@ def test_pipelined_pushes_dropped_not_misapplied():
 
         simulate_restart(servicers[0], generation=2,
                          rollback_to=v_applied)
+        # Seed a prefetched entry BEFORE the client can learn about the
+        # restart, to prove the reconcile invalidates it.  (Embedding
+        # pulls now carry the generation stamp too — the serving-tier
+        # lookup plane — so the very first post-restart minibatch's
+        # pull teaches the client, not only the fenced push responses.)
+        trainer._prefetched[("emb", b"sentinel")] = None
         # These steps pipeline pushes stamped with the generation the
-        # local params were last SYNCED under (gen 1 — unless the
-        # executor's fenced reject lands between them, in which case
-        # the second step reconciles first and its push legitimately
-        # carries gen 2; both interleavings are valid, the invariant
-        # below is interleaving-free).
+        # local params were last SYNCED under (gen 1 — unless an
+        # embedding pull's stamp or the executor's fenced reject lands
+        # between them, in which case a later step reconciles first and
+        # its push legitimately carries gen 2; all interleavings are
+        # valid, the invariant below is interleaving-free).
         trainer.train_minibatch(*data[1])
         trainer.train_minibatch(*data[2])
-        # Seed a prefetched entry to prove invalidation.
-        trainer._prefetched[("emb", b"sentinel")] = None
-        # next step hits the reconcile path (epoch bumped by the fenced
-        # push responses); the queued pushes drop, nothing mis-applies.
+        # next step hits the reconcile path (epoch bumped by the pull
+        # stamps / fenced push responses); the queued dead-incarnation
+        # pushes drop, nothing mis-applies.
         trainer.train_minibatch(*data[3])
         trainer.drain_pushes()
         fenced = servicers[0].counters["push_gen_rejected"]

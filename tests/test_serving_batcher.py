@@ -402,7 +402,9 @@ def test_statz_and_keepalive_over_http(tmp_path):
             np.testing.assert_array_equal(out, [[0.0, 1.0 * k]])
         conn.request("GET", "/statz")
         statz = json.loads(conn.getresponse().read())
-        stats = statz["lin"]
+        assert statz["draining"] is False  # fleet drain flag rides
+        # /statz so the router's health probe keys off one payload
+        stats = statz["models"]["lin"]
         assert stats["batching"]["max_batch_size"] == 4
         assert stats["batching"]["pad_buckets"] == [1, 2, 4]
         assert stats["counters"]["batcher.requests"] == 3
