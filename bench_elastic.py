@@ -199,7 +199,15 @@ def run_master_kill_drill(records=4160, deadline_secs=300):
     recovery_secs (kill -> first task completion after restart,
     observed by replaying the live journal) and asserts completed ==
     expected with zero permanent failures — a double-counted record
-    would overshoot, a lost one would hang/undershoot."""
+    would overshoot, a lost one would hang/undershoot.
+
+    Tracing gate (docs/observability.md): every process runs with
+    $ELASTICDL_TRACE_DIR armed; the surviving workers' and the
+    restarted master's flight-recorder dumps must stitch into ONE
+    connected trace covering kill (worker-side rpc_retry events in the
+    outage window) -> recovery (master #2's journal replay, linked via
+    link_trace) -> the first post-recovery task completion
+    (restart-stamped task.completed) — ``trace_connected`` below."""
     import shutil
     import signal
     import subprocess
@@ -207,6 +215,7 @@ def run_master_kill_drill(records=4160, deadline_secs=300):
 
     from elasticdl_tpu.master.journal import replay_journal
     from elasticdl_tpu.proto import elastic_pb2 as pb
+    from elasticdl_tpu.utils import tracing
     from elasticdl_tpu.utils.grpc_utils import find_free_port
 
     records_per_task = 32 * 4
@@ -214,6 +223,7 @@ def run_master_kill_drill(records=4160, deadline_secs=300):
     expected_tasks = -(-records // records_per_task) * num_epochs
     data_origin = "synthetic_mnist:%d" % records
     jdir = tempfile.mkdtemp(prefix="edl_journal_")
+    tdir = os.path.join(jdir, "traces")
     port = find_free_port()
     env = dict(
         os.environ,
@@ -221,6 +231,10 @@ def run_master_kill_drill(records=4160, deadline_secs=300):
         # Orphaned workers must die promptly if the job wedges; 45 s
         # comfortably covers the master restart gap.
         ELASTICDL_RPC_DEADLINE_SECS="45",
+        # Flight-recorder dumps on exit: workers + master #2 land here
+        # (master #1 is SIGKILLed — by definition it leaves no dump;
+        # the survivors' rings reconstruct the incident).
+        ELASTICDL_TRACE_DIR=tdir,
     )
     base_cmd = [
         sys.executable, "-m", "elasticdl_tpu.master.main",
@@ -289,6 +303,38 @@ def run_master_kill_drill(records=4160, deadline_secs=300):
         )
         out["journal_bytes"] = os.path.getsize(
             os.path.join(jdir, "job.journal")
+        )
+        # Trace gate: the orphaned workers exit (and dump) shortly
+        # after master #2 reports the job done — wait briefly for
+        # master #2 + both workers' rings (an idle worker that rides
+        # its WAIT poll into the reaper leaves no dump; the gate only
+        # needs ONE worker ring plus the master's).
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            dumps = (
+                [] if not os.path.isdir(tdir) else
+                [f for f in os.listdir(tdir)
+                 if f.endswith(".trace.json")]
+            )
+            if len(dumps) >= 2:
+                break
+            time.sleep(0.25)
+        events = tracing.load_dumps(tdir)
+        components = tracing.trace_components(events)
+
+        def _connected(component):
+            names = {e["name"] for e in component}
+            return (
+                {"rpc_retry", "journal.replayed",
+                 "task.completed"} <= names
+                and any(e.get("restart") for e in component
+                        if e["name"] == "task.completed")
+            )
+
+        out["trace_dumps"] = len(dumps)
+        out["trace_events"] = len(events)
+        out["trace_connected"] = any(
+            _connected(c) for c in components
         )
     finally:
         for proc in (master1, master2):

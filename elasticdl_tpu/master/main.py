@@ -16,6 +16,7 @@ from elasticdl_tpu.master.worker_manager import (
     WorkerManager,
 )
 from elasticdl_tpu.models.spec import load_model_spec
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import (
     build_arguments_from_parsed_result,
     parse_master_args,
@@ -99,7 +100,26 @@ def build_master(args):
     if args.journal_dir:
         from elasticdl_tpu.master.journal import replay_journal
 
-        journal_state = replay_journal(args.journal_dir)
+        # The recovery trace: journal replay is this incarnation's
+        # root recovery span; every later event this master records
+        # carries link_trace back to it, so a worker's outage-riding
+        # trace and the replay stitch into ONE incident component
+        # (docs/observability.md, cpu_master_kill drill gate).
+        with tracing.span("master.journal_replay") as replay_span:
+            journal_state = replay_journal(args.journal_dir)
+            if journal_state is not None:
+                tracing.event(
+                    "journal.replayed",
+                    restarts=journal_state.restarts,
+                    rendezvous_id=journal_state.rendezvous_id,
+                )
+        if journal_state is not None:
+            restart = journal_state.restarts + 1
+            tracing.configure_identity(
+                "master", generation=restart, restart=restart,
+                # replay_span is None when tracing is disabled
+                link_trace=getattr(replay_span, "trace", None),
+            )
     reader = create_data_reader(
         args.data_origin, records_per_shard=records_per_task
     )
@@ -313,6 +333,8 @@ def build_master(args):
 
 def main(argv=None):
     args = parse_master_args(argv)
+    tracing.configure_identity("master")
+    tracing.arm_crash_dump()
     logger.info("master starting: %s", vars(args))
     master = build_master(args)
     master.prepare()

@@ -13,6 +13,7 @@ relaunched workers triggers one re-compile, not many.
 import threading
 import time
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -162,6 +163,10 @@ class RendezvousServer:
                     self._rendezvous_id, self._cur_hosts,
                     self._coordinator_addr,
                 )
+            # Epoch commits run inside a worker's get_comm_rank server
+            # span, so the re-form lands in the polling worker's trace.
+            tracing.event("rendezvous.epoch", epoch=staged["n"],
+                          world_size=len(staged["hosts"]))
         with self._lock:
             if host in self._cur_hosts:
                 rank = self._cur_hosts.index(host)

@@ -7,10 +7,11 @@ train-loop membership, evaluation metric ingestion and version reports.
 """
 
 import threading
+import time
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.proto import rpc
-from elasticdl_tpu.utils import grpc_utils, tensor_codec
+from elasticdl_tpu.utils import grpc_utils, tensor_codec, tracing
 from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.master.task_manager import wait_task_pb
@@ -41,6 +42,13 @@ class MasterServicer:
         self.training_params = None
         self.worker_record_counts = {}  # worker_id -> records processed
         self.worker_exec_counters = {}  # counter name -> total
+        # Per-worker live training telemetry piggybacked on the
+        # coalesced progress RPCs (docs/observability.md): worker_id ->
+        # {steps_per_sec, sync_fraction, push_staleness, window_size,
+        # steps_done, age}.  The per-job aggregate over these series is
+        # the sensor input the multi-tenant resize controller (ROADMAP
+        # item 5) reads from /status and /metrics.
+        self.worker_telemetry = {}
         # PS recovery state from generation-tagged version reports
         # (docs/ps_recovery.md): ps_id -> {generation, version,
         # durable_version}.  Observability only (status page, drills);
@@ -94,6 +102,17 @@ class MasterServicer:
             request.task_id, success, request.err_message,
             requeue=request.requeue,
         )
+        # Flight-recorder breadcrumbs in the CALLER's trace (the server
+        # span set by TraceServerInterceptor): a drill can follow one
+        # task from dispatch through its completion/re-queue across the
+        # worker and master rings.
+        if success:
+            tracing.event("task.completed", task=request.task_id)
+        elif request.requeue:
+            tracing.event("task.requeued", task=request.task_id)
+        else:
+            tracing.event("task.fail_reported", task=request.task_id,
+                          error=request.err_message[:200])
         if (
             self._evaluation_service is not None
             and result.task is not None
@@ -114,6 +133,29 @@ class MasterServicer:
             self.worker_record_counts[request.worker_id] = (
                 prev + request.record_count
             )
+            if request.steps_done > 0:
+                # Telemetry rides the progress report (proto fields
+                # 3-7); absent fields decode as 0 — a worker predating
+                # the telemetry piggyback just never lands here.
+                now = time.time()
+                self.worker_telemetry[request.worker_id] = {
+                    "steps_per_sec": request.steps_per_sec,
+                    "sync_fraction": request.sync_fraction,
+                    "push_staleness": request.push_staleness,
+                    "window_size": request.window_size,
+                    "steps_done": request.steps_done,
+                    "ts": now,
+                }
+                # Bound the dict even when nothing polls telemetry()
+                # (--status_port off is the default): past a generous
+                # live-worker count, drop long-dead entries here too.
+                if len(self.worker_telemetry) > 64:
+                    cutoff = now - self.TELEMETRY_EVICT_SECS
+                    for worker_id in [
+                        w for w, t in self.worker_telemetry.items()
+                        if t["ts"] < cutoff
+                    ]:
+                        del self.worker_telemetry[worker_id]
         if self._journal is not None:
             self._journal.append(
                 {"ev": "batch", "w": request.worker_id,
@@ -165,6 +207,50 @@ class MasterServicer:
             )
         return pb.Empty()
 
+    # A worker whose last telemetry report is older than this is
+    # excluded from the JOB aggregate (it is preempted, finished, or
+    # mid-outage — summing its stale steps/s would overstate the job),
+    # but stays in the per-worker view with its age visible ...
+    TELEMETRY_STALE_SECS = 60.0
+    # ... until this much older, when the entry is EVICTED outright:
+    # a long elastic job churns through ever-new worker ids, and
+    # without eviction both the dict and the /status payload grow
+    # without bound while exporting hours-dead workers' last values.
+    TELEMETRY_EVICT_SECS = 900.0
+
+    def telemetry(self, now=None):
+        """Copy-safe per-worker + per-job telemetry aggregate: the
+        resize-controller sensor surface (/status "telemetry" section,
+        /metrics elasticdl_job_steps_per_sec et al)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            dead = [
+                worker_id
+                for worker_id, t in self.worker_telemetry.items()
+                if now - t["ts"] > self.TELEMETRY_EVICT_SECS
+            ]
+            for worker_id in dead:
+                del self.worker_telemetry[worker_id]
+            workers = {
+                worker_id: dict(t)
+                for worker_id, t in self.worker_telemetry.items()
+            }
+        live_rate = 0.0
+        reporting = 0
+        for t in workers.values():
+            t["age_secs"] = round(now - t.pop("ts"), 3)
+            t["fresh"] = t["age_secs"] <= self.TELEMETRY_STALE_SECS
+            if t["fresh"]:
+                reporting += 1
+                live_rate += t["steps_per_sec"]
+        return {
+            "workers": workers,
+            "job": {
+                "steps_per_sec": round(live_rate, 3),
+                "workers_reporting": reporting,
+            },
+        }
+
     def ps_state(self):
         """Copy-safe snapshot of per-shard PS recovery state for the
         status page."""
@@ -194,6 +280,7 @@ class MasterServicer:
 
     @rpc_error_guard
     def report_version(self, request, _context=None):
+        shard_restarted = False
         with self._lock:
             advanced = request.model_version > self._version
             self._version = max(self._version, request.model_version)
@@ -214,6 +301,7 @@ class MasterServicer:
                     if state["generation"] and (
                         request.generation > state["generation"]
                     ):
+                        shard_restarted = True
                         logger.warning(
                             "PS shard %d serving as generation %d "
                             "(was %d): shard restarted",
@@ -228,6 +316,12 @@ class MasterServicer:
                     # an older committed version really is durable only
                     # up to there — the mark must move back with it.
                     state["durable_version"] = request.durable_version
+        if shard_restarted:
+            # In the reporting shard's trace: the restart-generation
+            # bump as the master observed it.
+            tracing.event("ps.generation_bump", ps_id=request.ps_id,
+                          generation=request.generation,
+                          durable_version=request.durable_version)
         if advanced and self._journal is not None:
             self._journal.append(
                 {"ev": "version", "v": request.model_version}

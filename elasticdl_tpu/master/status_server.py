@@ -7,28 +7,35 @@ pull surface on the master itself:
   GET /healthz   -> 200 "ok" (liveness/readiness probe target)
   GET /status    -> JSON: task counts (todo/doing/completed/failed,
                     epoch), live workers, rendezvous epoch + world,
-                    worker exec counters
+                    worker exec counters, per-worker training telemetry
   GET /metrics   -> the same numbers in Prometheus text exposition
                     format (elasticdl_tasks_todo, ..._completed{type=},
                     elasticdl_workers_live, elasticdl_rendezvous_epoch)
+  GET /tracez    -> the process flight recorder (utils/tracing.py);
+                    ?fmt=chrome renders Chrome trace-event JSON for
+                    Perfetto (docs/observability.md)
 
 Stdlib-only (ThreadingHTTPServer), read-only, zero coupling into the
 control plane beyond the objects it snapshots.  Enabled with
 ``--status_port`` (master flag); port 0 picks a free one.
 
-This module is also the home of every Prometheus exposition renderer in
-the system — the PS status page, the serving replicas' /metrics
-(``serving_to_prometheus``), and the fleet router's /metrics
-(``fleet_to_prometheus``) all share ``prometheus_line``, so the drills
-and a real scraper read ONE format across the control plane, the PS
-tier, and the serving tier.
+The Prometheus renderers live in ``utils/prom.py`` (single escaping /
+labels implementation for the whole system); this module re-exports
+them so historical imports keep working.
 """
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.prom import (  # noqa: F401  (re-exported API)
+    fleet_to_prometheus,
+    prometheus_line,
+    serving_to_prometheus,
+    to_prometheus,
+)
 
 logger = get_logger(__name__)
 
@@ -48,6 +55,12 @@ def collect_status(task_manager, worker_manager=None,
         }
     if servicer is not None:
         status["exec_counters"] = dict(servicer.worker_exec_counters)
+        telemetry = servicer.telemetry()
+        if telemetry["workers"]:
+            # Per-worker steps/s, sync_fraction, push staleness,
+            # fused-window stats piggybacked on the coalesced progress
+            # RPCs — the resize-controller sensor input (ROADMAP 5).
+            status["telemetry"] = telemetry
         ps_state = servicer.ps_state()
         if ps_state:
             # PS recovery plane (docs/ps_recovery.md): per-shard
@@ -60,130 +73,11 @@ def collect_status(task_manager, worker_manager=None,
     return status
 
 
-def prometheus_line(metric, value, **labels):
-    """One exposition-format sample line — THE renderer both the
-    master's and the PS's /metrics share."""
-    label_str = ""
-    if labels:
-        label_str = "{%s}" % ",".join(
-            '%s="%s"' % kv for kv in sorted(labels.items()))
-    return "%s%s %s" % (metric, label_str, value)
-
-
-def to_prometheus(status):
-    lines = []
-
-    def gauge(metric, value, **labels):
-        lines.append(prometheus_line(metric, value, **labels))
-
-    tasks = status["tasks"]
-    gauge("elasticdl_tasks_todo", tasks["todo"])
-    gauge("elasticdl_tasks_doing", tasks["doing"])
-    gauge("elasticdl_data_epoch", tasks["epoch"])
-    for kind in ("completed", "failed"):
-        for task_type, count in tasks[kind].items():
-            gauge("elasticdl_tasks_%s" % kind, count,
-                  type=str(task_type))
-    gauge("elasticdl_job_finished", int(status["finished"]))
-    if "workers" in status:
-        gauge("elasticdl_workers_live", len(status["workers"]["live"]))
-    if "rendezvous" in status:
-        gauge("elasticdl_rendezvous_epoch",
-              status["rendezvous"]["epoch"])
-        gauge("elasticdl_rendezvous_world_size",
-              len(status["rendezvous"]["world"]))
-    for name, value in status.get("exec_counters", {}).items():
-        gauge("elasticdl_worker_counter", value, name=name)
-    if "ps" in status:
-        gauge("elasticdl_ps_commit_mark", status["ps"]["commit_mark"])
-        for ps_id, shard in sorted(status["ps"]["shards"].items()):
-            gauge("elasticdl_ps_shard_generation",
-                  shard["generation"], ps_id=str(ps_id))
-            gauge("elasticdl_ps_shard_durable_version",
-                  shard["durable_version"], ps_id=str(ps_id))
-    return "\n".join(lines) + "\n"
-
-
-def serving_to_prometheus(status):
-    """Serving-replica /metrics renderer (serving/server.py) — mirrors
-    the master's ``elasticdl_ps_commit_mark`` convention so the fleet
-    router, the drills, and a Prometheus scraper read ONE format across
-    the control plane and the serving tier.
-
-    ``status``: {"draining": bool, "models": {name: endpoint.stats()}}.
-    """
-    lines = [prometheus_line("elasticdl_serving_draining",
-                             int(status.get("draining", False)))]
-    for name, stats in sorted(status.get("models", {}).items()):
-        counters = stats.get("counters", {})
-
-        def gauge(metric, value, _model=name):
-            lines.append(prometheus_line(metric, value, model=_model))
-
-        gauge("elasticdl_serving_version", stats.get("version", 0))
-        gauge("elasticdl_serving_requests",
-              counters.get("batcher.requests", 0))
-        gauge("elasticdl_serving_batches",
-              counters.get("batcher.batches", 0))
-        occupancy = stats.get("mean_batch_occupancy")
-        if occupancy is not None:
-            gauge("elasticdl_serving_occupancy", occupancy)
-        wait = stats.get("timing", {}).get("batcher.queue_wait")
-        if wait:
-            gauge("elasticdl_serving_queue_wait_ms",
-                  1e3 * wait["mean_s"])
-        cache = stats.get("emb_cache")
-        if cache:
-            gauge("elasticdl_serving_emb_cache_bytes", cache["bytes"])
-            gauge("elasticdl_serving_emb_cache_rows", cache["rows"])
-            gauge("elasticdl_serving_emb_cache_evicted_rows",
-                  cache["evicted_rows"])
-            if cache.get("hit_ratio") is not None:
-                gauge("elasticdl_serving_emb_cache_hit_ratio",
-                      round(cache["hit_ratio"], 6))
-    return "\n".join(lines) + "\n"
-
-
-def fleet_to_prometheus(status):
-    """Router /metrics renderer (serving/router.py): the FLEET view —
-    committed version, per-replica health/load/version, routing
-    counters — in the same exposition format as everything else.
-
-    ``status``: the router's ``fleet_status()`` dict.
-    """
-    lines = [
-        prometheus_line("elasticdl_fleet_committed_version",
-                        status.get("committed_version", 0)),
-        prometheus_line("elasticdl_fleet_replicas_healthy",
-                        sum(1 for r in status.get("replicas", {})
-                            .values() if r.get("healthy"))),
-        prometheus_line("elasticdl_fleet_replicas_total",
-                        len(status.get("replicas", {}))),
-    ]
-    for addr, rep in sorted(status.get("replicas", {}).items()):
-        def gauge(metric, value, _addr=addr):
-            lines.append(prometheus_line(metric, value, replica=_addr))
-
-        gauge("elasticdl_fleet_replica_healthy",
-              int(rep.get("healthy", False)))
-        gauge("elasticdl_fleet_replica_serving_version",
-              rep.get("serving_version", 0))
-        gauge("elasticdl_fleet_replica_inflight",
-              rep.get("inflight", 0))
-        if rep.get("queue_wait_ms") is not None:
-            gauge("elasticdl_fleet_replica_queue_wait_ms",
-                  rep["queue_wait_ms"])
-    for name, value in sorted(status.get("counters", {}).items()):
-        lines.append(prometheus_line("elasticdl_fleet_router_counter",
-                                     value, name=name))
-    return "\n".join(lines) + "\n"
-
-
 class HttpStatusServer:
-    """Generic /healthz /status /metrics server over a collect_fn
-    (returns the JSON-able status dict) and a prom_fn (renders it as
-    Prometheus text).  The master's StatusServer and the PS's metrics
-    endpoint are both instances."""
+    """Generic /healthz /status /metrics /tracez server over a
+    collect_fn (returns the JSON-able status dict) and a prom_fn
+    (renders it as Prometheus text).  The master's StatusServer and
+    the PS's metrics endpoint are both instances."""
 
     def __init__(self, collect_fn, prom_fn, port=0, host="0.0.0.0"):
         class Handler(BaseHTTPRequestHandler):
@@ -201,6 +95,13 @@ class HttpStatusServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     return self._reply(200, "ok\n", "text/plain")
+                if tracing.is_tracez_path(self.path):
+                    # Live flight-recorder query: independent of
+                    # collect_fn so a wedged control plane can still
+                    # be traced.
+                    return self._reply(
+                        200, tracing.tracez_body(self.path),
+                        "application/json")
                 try:
                     status = collect_fn()
                 except Exception as e:  # noqa: BLE001 — a probe must
@@ -227,7 +128,7 @@ class HttpStatusServer:
     def start(self):
         self._thread.start()
         logger.info("status server on port %d "
-                    "(/healthz /status /metrics)", self.port)
+                    "(/healthz /status /metrics /tracez)", self.port)
 
     def stop(self):
         self._server.shutdown()

@@ -16,6 +16,7 @@ from collections import deque, namedtuple
 
 from elasticdl_tpu.master.journal import journal_events
 from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -302,6 +303,14 @@ class TaskManager:
                 task_id, success, err_message, requeue, events
             )
         journal_events(self._journal, events)
+        # Mirror the task-lifecycle journal events into the flight
+        # recorder (same outside-the-lock discipline): a task put BACK
+        # in the queue — retry or requeue — is the elastic incident a
+        # trace wants, and it lands in the reporting worker's trace
+        # (servicer records the completion-side breadcrumbs).
+        for ev in events:
+            if ev.get("ev") == "requeue":
+                tracing.event("task.requeue", task=ev.get("id"))
         return result
 
     def _report_locked(self, task_id, success, err_message, requeue,

@@ -11,6 +11,7 @@ elasticdl/proto/elasticdl.proto:41-86).
 import grpc
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import tracing
 
 # service name -> {method name: (request class, response class)}
 SERVICES = {
@@ -45,19 +46,62 @@ SERVICES = {
 }
 
 
+class _TracedMultiCallable:
+    """Wraps one unary-unary multicallable with trace-context
+    propagation (utils/tracing.py): the blocking form runs inside a
+    ``rpc.client`` span; both forms inject the caller's (trace, span)
+    ids as gRPC metadata so the server-side interceptor links its span
+    to ours.  ``.future`` is preserved for the PS client's fan-out
+    (the async completion records an instant event, not a span — its
+    end is observed on another thread via ``.result()``)."""
+
+    __slots__ = ("_call", "_name", "_tracer")
+
+    def __init__(self, call, name, tracer):
+        self._call = call
+        self._name = name
+        self._tracer = tracer
+
+    def __call__(self, request, timeout=None, metadata=None, **kwargs):
+        if not self._tracer.enabled:
+            return self._call(request, timeout=timeout,
+                              metadata=metadata, **kwargs)
+        with self._tracer.span("rpc.client/%s" % self._name,
+                               kind="client"):
+            return self._call(
+                request, timeout=timeout,
+                metadata=self._tracer.inject(metadata), **kwargs
+            )
+
+    def future(self, request, timeout=None, metadata=None, **kwargs):
+        if not self._tracer.enabled:
+            return self._call.future(request, timeout=timeout,
+                                     metadata=metadata, **kwargs)
+        self._tracer.event("rpc.client_async/%s" % self._name)
+        return self._call.future(
+            request, timeout=timeout,
+            metadata=self._tracer.inject(metadata), **kwargs
+        )
+
+
 def _make_stub_class(service_name):
     methods = SERVICES[service_name]
 
     class Stub:
         def __init__(self, channel):
+            tracer = tracing.default_tracer()
             for name, (req_cls, res_cls) in methods.items():
                 setattr(
                     self,
                     name,
-                    channel.unary_unary(
-                        "/%s/%s" % (service_name, name),
-                        request_serializer=req_cls.SerializeToString,
-                        response_deserializer=res_cls.FromString,
+                    _TracedMultiCallable(
+                        channel.unary_unary(
+                            "/%s/%s" % (service_name, name),
+                            request_serializer=req_cls.SerializeToString,
+                            response_deserializer=res_cls.FromString,
+                        ),
+                        name,
+                        tracer,
                     ),
                 )
 

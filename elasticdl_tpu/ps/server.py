@@ -16,7 +16,7 @@ from elasticdl_tpu.proto import rpc
 from elasticdl_tpu.ps.optimizer import create_optimizer
 from elasticdl_tpu.ps.parameters import Parameters
 from elasticdl_tpu.ps.servicer import PserverServicer
-from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.utils import grpc_utils, tracing
 from elasticdl_tpu.utils.args import parse_ps_args
 from elasticdl_tpu.utils.checkpoint import CheckpointSaver
 from elasticdl_tpu.utils.logging import get_logger
@@ -73,6 +73,13 @@ class ParameterServer:
             args.checkpoint_dir or args.checkpoint_dir_for_init,
             args.ps_id, hint=getattr(args, "generation", 0),
         )
+        # Identity now carries the incarnation: "[ps-0@g2]" log lines
+        # and generation-stamped flight-recorder events make a relaunch
+        # attributable at a glance in interleaved drill logs.
+        tracing.configure_identity("ps", rank=args.ps_id,
+                                   generation=self.generation)
+        tracing.event("ps.generation_established",
+                      generation=self.generation)
         logger.info("PS shard %d starting as generation %d",
                     args.ps_id, self.generation)
         saver = None
@@ -178,8 +185,8 @@ class ParameterServer:
         if getattr(self.args, "status_port", -1) >= 0:
             from elasticdl_tpu.master.status_server import (
                 HttpStatusServer,
-                prometheus_line,
             )
+            from elasticdl_tpu.utils.prom import prometheus_line
 
             def collect():
                 return {
@@ -286,6 +293,9 @@ def main(argv=None):
     ps = ParameterServer(args, master_client=master_client)
     ps.prepare()
     signal.signal(signal.SIGTERM, lambda *a: ps.stop(checkpoint=True))
+    # AFTER the graceful-checkpoint hook: SIGTERM dumps the flight
+    # recorder first, then runs the checkpoint-and-stop chain.
+    tracing.arm_crash_dump()
     ps.run()
     return 0
 

@@ -30,7 +30,7 @@ import time
 import numpy as np
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
-from elasticdl_tpu.utils import tensor_codec
+from elasticdl_tpu.utils import tensor_codec, tracing
 from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
 
@@ -189,6 +189,12 @@ class PserverServicer:
         with self._lock:
             self.counters["push_gen_rejected"] += 1
             version = self._params.version
+        # In the PUSHER's trace (server span): the fence as the shard
+        # saw it — a churn drill's timeline shows which worker's dead-
+        # incarnation push was refused, and when.
+        tracing.event("ps.push_fenced",
+                      dead_generation=request_generation,
+                      generation=self.generation, version=version)
         logger.warning(
             "rejecting gradients stamped by generation %d (serving "
             "generation %d): pushed by a dead incarnation's worker view",
@@ -425,9 +431,12 @@ class PserverServicer:
             # Sibling shards GC concurrently; a lost checkpoint must
             # never fail the worker's push RPC.
             self.counters["ps_ckpt_failed"] += 1
+            tracing.event("ps.checkpoint_failed", version=v,
+                          error=str(e)[:200])
             logger.warning("checkpoint at v%d failed: %s", v, e)
             return False
         self._durable_version = v
+        tracing.event("ps.checkpoint", version=v)
         return True
 
     def _post_update_locked(self):

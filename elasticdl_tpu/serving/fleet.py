@@ -44,6 +44,7 @@ import threading
 import time
 
 from elasticdl_tpu.serving.loader import list_versions
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.retry import serving_probe_policy
 
@@ -488,7 +489,14 @@ class FleetCoordinator:
 
     def rollout(self, target):
         """The no-mixed-version hot-swap: pre-warm everywhere, wait for
-        all-N-ready, then flip atomically behind the admission gate."""
+        all-N-ready, then flip atomically behind the admission gate.
+        One ``fleet.rollout`` span covers prepare-everywhere through
+        the commit barrier (docs/observability.md)."""
+        with tracing.span("fleet.rollout", target=target,
+                          committed=self.committed_version):
+            return self._rollout_traced(target)
+
+    def _rollout_traced(self, target):
         logger.info("fleet rollout: version %d -> %d",
                     self.committed_version, target)
         deadline = time.monotonic() + self.barrier_timeout
@@ -530,6 +538,7 @@ class FleetCoordinator:
         """All replicas warm: close the admission gate, drain in-flight
         forwards, commit everywhere, flip, reopen.  The gate pause is
         milliseconds (commit publishes an already-warm model)."""
+        tracing.event("fleet.barrier_close", target=target)
         if self.gate is not None:
             self.gate.close()
         try:
@@ -564,5 +573,6 @@ class FleetCoordinator:
         finally:
             if self.gate is not None:
                 self.gate.open()
+            tracing.event("fleet.barrier_open", target=target)
         logger.info("fleet committed version is now %d", target)
         return True

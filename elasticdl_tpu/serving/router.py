@@ -46,7 +46,6 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from elasticdl_tpu.master.status_server import fleet_to_prometheus
 from elasticdl_tpu.serving.fleet import (
     FleetCoordinator,
     FleetState,
@@ -54,8 +53,10 @@ from elasticdl_tpu.serving.fleet import (
     pick_replica,
     rendezvous_rank,
 )
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import build_router_parser
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.prom import fleet_to_prometheus
 
 __all__ = [
     "AdmissionGate", "Router", "build_router_server", "main",
@@ -364,6 +365,12 @@ def build_router_server(router, port=0, host="127.0.0.1",
                     200,
                     fleet_to_prometheus(router.fleet_status()).encode(),
                     "text/plain; version=0.0.4")
+            if tracing.is_tracez_path(self.path):
+                # Router flight recorder: barrier spans, ejections,
+                # failovers — same query API as every other tier.
+                return self._reply_raw(
+                    200, tracing.tracez_body(self.path).encode(),
+                    "application/json")
             if self.path.startswith("/v1/"):
                 status, body, content_type, _ = router.forward(
                     "GET", self.path, None)
@@ -409,6 +416,8 @@ def build_router_server(router, port=0, host="127.0.0.1",
 
 def main(argv=None):
     args = build_router_parser().parse_args(argv)
+    tracing.configure_identity("router", rank=args.port)
+    tracing.arm_crash_dump()
     replicas = [a.strip() for a in args.replicas.split(",")
                 if a.strip()]
     if not replicas:

@@ -68,9 +68,10 @@ from elasticdl_tpu.serving.loader import (
     load_servable,
     resolve_export_dir,
 )
-from elasticdl_tpu.master.status_server import serving_to_prometheus
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import build_serving_parser
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.prom import serving_to_prometheus
 from elasticdl_tpu.utils.timing import Timing
 
 logger = get_logger(__name__)
@@ -388,6 +389,11 @@ class ModelEndpoint:
             # Version-keyed cache invalidation: PS-backed rows never
             # survive a version flip (docs/serving.md fleet section).
             self._embedding_service.set_version(version)
+        # In the coordinator's trace (the commit arrives as an HTTP
+        # POST, so no gRPC propagation — the replica-local instant is
+        # still the serving half of the barrier timeline).
+        tracing.event("serving.version_commit", model=self.name,
+                      version=version)
         logger.info("fleet commit: model %r now serving version %d",
                     self.name, version)
         return {"committed": True, "serving": version}
@@ -667,11 +673,18 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
                 return self._reply(200, self._statz())
             if self.path == "/metrics":
                 # The same numbers in Prometheus exposition format
-                # (master status-server convention), so the router and
+                # (shared utils/prom.py renderer), so the router and
                 # the fleet drills scrape one format everywhere.
                 return self._reply_text(
                     200, serving_to_prometheus(self._statz()),
                     "text/plain; version=0.0.4")
+            if tracing.is_tracez_path(self.path):
+                # Live flight recorder (utils/tracing.py): hot-swap
+                # barrier spans and lookup incidents, same query API
+                # as every other tier's /tracez.
+                return self._reply_text(
+                    200, tracing.tracez_body(self.path),
+                    "application/json")
             if self.path == "/fleet/state":
                 return self._reply(200, {
                     "draining": drain.draining,
@@ -757,6 +770,7 @@ def batch_config_from_args(args):
 
 def main(argv=None):
     args = build_serving_parser().parse_args(argv)
+    tracing.configure_identity("serving", rank=args.port)
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
         # The session sitecustomize can pin another backend via
         # jax.config (overriding JAX_PLATFORMS); honor the explicit
@@ -827,6 +841,9 @@ def main(argv=None):
     server = build_server(endpoints, port=args.port, host=args.host)
     install_drain_handler(server, endpoints, server.drain,
                           grace_secs=args.drain_grace_secs)
+    # AFTER the drain hook: SIGTERM dumps the flight recorder, then
+    # the drain chain runs ($ELASTICDL_TRACE_DIR gates the dump).
+    tracing.arm_crash_dump()
     logger.info(
         "serving model(s) %s on %s:%d (predict: POST "
         "/v1/models/<name>:predict; batching: %s; fleet_managed: %s; "

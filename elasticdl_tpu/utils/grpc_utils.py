@@ -17,6 +17,7 @@ from concurrent import futures
 
 import grpc
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -350,11 +351,50 @@ class RpcDelayInterceptor(FaultInjectionInterceptor):
         super().__init__(spec)
 
 
+class TraceServerInterceptor(grpc.ServerInterceptor):
+    """Adopts the caller's trace context from gRPC metadata
+    (utils/tracing.py) and runs every unary handler inside a server
+    span, so servicer-side flight-recorder events (task completions,
+    generation fences, checkpoint commits) land in the SAME trace as
+    the worker that caused them.  Installed on every server by
+    ``build_server``; a no-op passthrough when tracing is disabled."""
+
+    def __init__(self, tracer=None):
+        self._tracer = tracer or tracing.default_tracer()
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if (
+            handler is None
+            or handler.unary_unary is None
+            or not self._tracer.enabled
+        ):
+            return handler
+        inner = handler.unary_unary
+        method = handler_call_details.method
+        metadata = handler_call_details.invocation_metadata
+        tracer = self._tracer
+
+        def traced(request, context):
+            with tracer.server_span(method, metadata):
+                return inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            traced,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
 def build_server(max_workers=64, interceptors=None):
+    # The trace interceptor is outermost so injected faults, delays,
+    # and aborts from later interceptors are visible INSIDE the span
+    # (an aborted RPC records its span end with the abort error).
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=CHANNEL_OPTIONS,
-        interceptors=interceptors or (),
+        interceptors=[TraceServerInterceptor()]
+        + list(interceptors or ()),
     )
 
 

@@ -1,0 +1,174 @@
+"""Prometheus text-exposition rendering — the ONE implementation.
+
+Every /metrics endpoint in the system (master status server, PS shard,
+serving replicas, fleet router) renders through ``prometheus_line``,
+so label escaping exists exactly once and a real scraper reads one
+format across the control plane, the PS tier, and the serving tier.
+Before this module the renderers lived in master/status_server.py
+(which still re-exports them for compatibility); the serving tier now
+imports from here and no longer depends on the master package.
+
+Escaping per the exposition format spec: label values escape
+backslash, double-quote, and newline.  Metric names and label names
+are caller-controlled identifiers and are NOT escaped — a bad name is
+a bug, not data.
+"""
+
+
+def escape_label_value(value):
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def prometheus_line(metric, value, **labels):
+    """One exposition-format sample line."""
+    label_str = ""
+    if labels:
+        label_str = "{%s}" % ",".join(
+            '%s="%s"' % (name, escape_label_value(val))
+            for name, val in sorted(labels.items())
+        )
+    return "%s%s %s" % (metric, label_str, value)
+
+
+def to_prometheus(status):
+    """Master /metrics renderer over ``collect_status``'s dict."""
+    lines = []
+
+    def gauge(metric, value, **labels):
+        lines.append(prometheus_line(metric, value, **labels))
+
+    tasks = status["tasks"]
+    gauge("elasticdl_tasks_todo", tasks["todo"])
+    gauge("elasticdl_tasks_doing", tasks["doing"])
+    gauge("elasticdl_data_epoch", tasks["epoch"])
+    for kind in ("completed", "failed"):
+        for task_type, count in tasks[kind].items():
+            gauge("elasticdl_tasks_%s" % kind, count,
+                  type=str(task_type))
+    gauge("elasticdl_job_finished", int(status["finished"]))
+    if "workers" in status:
+        gauge("elasticdl_workers_live", len(status["workers"]["live"]))
+    if "rendezvous" in status:
+        gauge("elasticdl_rendezvous_epoch",
+              status["rendezvous"]["epoch"])
+        gauge("elasticdl_rendezvous_world_size",
+              len(status["rendezvous"]["world"]))
+    for name, value in status.get("exec_counters", {}).items():
+        gauge("elasticdl_worker_counter", value, name=name)
+    if "ps" in status:
+        gauge("elasticdl_ps_commit_mark", status["ps"]["commit_mark"])
+        for ps_id, shard in sorted(status["ps"]["shards"].items()):
+            gauge("elasticdl_ps_shard_generation",
+                  shard["generation"], ps_id=str(ps_id))
+            gauge("elasticdl_ps_shard_durable_version",
+                  shard["durable_version"], ps_id=str(ps_id))
+    # Per-worker training telemetry piggybacked on the coalesced
+    # progress RPCs (docs/observability.md): the sensor input the
+    # future multi-tenant resize controller reads.
+    telemetry = status.get("telemetry")
+    if telemetry:
+        job = telemetry.get("job", {})
+        if job.get("steps_per_sec") is not None:
+            gauge("elasticdl_job_steps_per_sec",
+                  round(job["steps_per_sec"], 3))
+        gauge("elasticdl_telemetry_workers_reporting",
+              job.get("workers_reporting", 0))
+        for worker_id, t in sorted(telemetry.get("workers", {}).items()):
+            if not t.get("fresh", True):
+                # Stale workers stay in the /status JSON (with their
+                # age) but leave /metrics: a scraper reading per-worker
+                # gauges must never sum an hours-dead worker's last
+                # steps/s into "live" throughput.
+                continue
+            labels = {"worker": str(worker_id)}
+            gauge("elasticdl_worker_steps_per_sec",
+                  round(t.get("steps_per_sec", 0.0), 3), **labels)
+            if t.get("sync_fraction") is not None:
+                gauge("elasticdl_worker_sync_fraction",
+                      round(t["sync_fraction"], 4), **labels)
+            if t.get("push_staleness") is not None:
+                gauge("elasticdl_worker_push_staleness",
+                      round(t["push_staleness"], 3), **labels)
+            if t.get("window_size") is not None:
+                gauge("elasticdl_worker_window_size",
+                      round(t["window_size"], 3), **labels)
+            gauge("elasticdl_worker_steps_done",
+                  t.get("steps_done", 0), **labels)
+    return "\n".join(lines) + "\n"
+
+
+def serving_to_prometheus(status):
+    """Serving-replica /metrics renderer (serving/server.py).
+
+    ``status``: {"draining": bool, "models": {name: endpoint.stats()}}.
+    """
+    lines = [prometheus_line("elasticdl_serving_draining",
+                             int(status.get("draining", False)))]
+    for name, stats in sorted(status.get("models", {}).items()):
+        counters = stats.get("counters", {})
+
+        def gauge(metric, value, _model=name):
+            lines.append(prometheus_line(metric, value, model=_model))
+
+        gauge("elasticdl_serving_version", stats.get("version", 0))
+        gauge("elasticdl_serving_requests",
+              counters.get("batcher.requests", 0))
+        gauge("elasticdl_serving_batches",
+              counters.get("batcher.batches", 0))
+        occupancy = stats.get("mean_batch_occupancy")
+        if occupancy is not None:
+            gauge("elasticdl_serving_occupancy", occupancy)
+        wait = stats.get("timing", {}).get("batcher.queue_wait")
+        if wait:
+            gauge("elasticdl_serving_queue_wait_ms",
+                  1e3 * wait["mean_s"])
+        cache = stats.get("emb_cache")
+        if cache:
+            gauge("elasticdl_serving_emb_cache_bytes", cache["bytes"])
+            gauge("elasticdl_serving_emb_cache_rows", cache["rows"])
+            gauge("elasticdl_serving_emb_cache_evicted_rows",
+                  cache["evicted_rows"])
+            if cache.get("hit_ratio") is not None:
+                gauge("elasticdl_serving_emb_cache_hit_ratio",
+                      round(cache["hit_ratio"], 6))
+    return "\n".join(lines) + "\n"
+
+
+def fleet_to_prometheus(status):
+    """Router /metrics renderer (serving/router.py): the FLEET view —
+    committed version, per-replica health/load/version, routing
+    counters.
+
+    ``status``: the router's ``fleet_status()`` dict.
+    """
+    lines = [
+        prometheus_line("elasticdl_fleet_committed_version",
+                        status.get("committed_version", 0)),
+        prometheus_line("elasticdl_fleet_replicas_healthy",
+                        sum(1 for r in status.get("replicas", {})
+                            .values() if r.get("healthy"))),
+        prometheus_line("elasticdl_fleet_replicas_total",
+                        len(status.get("replicas", {}))),
+    ]
+    for addr, rep in sorted(status.get("replicas", {}).items()):
+        def gauge(metric, value, _addr=addr):
+            lines.append(prometheus_line(metric, value, replica=_addr))
+
+        gauge("elasticdl_fleet_replica_healthy",
+              int(rep.get("healthy", False)))
+        gauge("elasticdl_fleet_replica_serving_version",
+              rep.get("serving_version", 0))
+        gauge("elasticdl_fleet_replica_inflight",
+              rep.get("inflight", 0))
+        if rep.get("queue_wait_ms") is not None:
+            gauge("elasticdl_fleet_replica_queue_wait_ms",
+                  rep["queue_wait_ms"])
+    for name, value in sorted(status.get("counters", {}).items()):
+        lines.append(prometheus_line("elasticdl_fleet_router_counter",
+                                     value, name=name))
+    return "\n".join(lines) + "\n"

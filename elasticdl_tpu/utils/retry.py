@@ -20,6 +20,7 @@ import random
 
 import grpc
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -149,12 +150,21 @@ class RetryPolicy:
                     stop is not None and stop()
                 ):
                     self._bump("rpc_gaveup")
+                    tracing.event("rpc_gaveup", policy=self.name,
+                                  what=what, attempts=attempt,
+                                  error=str(err)[:200])
                     logger.error(
                         "%s: %s failed after %d attempt(s) / %.1fs: %s",
                         self.name, what, attempt, elapsed, err,
                     )
                     raise
                 self._bump("rpc_retry")
+                # Outage-riding evidence in the flight recorder: these
+                # instants inherit the caller's span context, so a
+                # drill's kill window shows up INSIDE the affected
+                # trace (docs/observability.md).
+                tracing.event("rpc_retry", policy=self.name, what=what,
+                              attempt=attempt, error=str(err)[:200])
                 logger.warning(
                     "%s: %s unavailable (attempt %d, %.1fs elapsed), "
                     "retrying in %.2fs: %s",

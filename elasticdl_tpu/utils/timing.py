@@ -4,9 +4,22 @@ Built-in observability from day one (SURVEY.md §5.1): the reference only has
 a DEBUG-level Timing helper (elasticdl/python/common/timing_utils.py:17-48);
 here timing is always on, cheap, and reportable, and integrates with the JAX
 profiler for device traces.
+
+Thread model: phases and counters are written by training/executor
+threads while /statz, /metrics, and Timing.report() readers snapshot
+concurrently.  Every mutation AND every snapshot runs under one plain
+lock — the critical sections are a handful of dict operations (never
+IO, never another lock), so the hot-path cost is one uncontended
+acquire (~100 ns) and a reader can never observe a torn
+(total bumped, count not) pair or a mid-resize dict.  The historical
+``dict(list(...))`` snapshot idiom protected ``counters()``/
+``summary()`` but left ``report()``/``sync_fraction`` reading live
+dicts; the hammer test in tests/test_observability.py drives writers
+against every snapshot path.
 """
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 
@@ -17,25 +30,27 @@ class Timing:
     def __init__(self, enabled=True, logger=None):
         self._enabled = enabled
         self._logger = logger
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self):
-        self._totals = defaultdict(float)
-        self._counts = defaultdict(int)
-        self._starts = {}
-        self._events = defaultdict(int)
+        with self._lock:
+            self._totals = defaultdict(float)
+            self._counts = defaultdict(int)
+            self._starts = {}
+            self._events = defaultdict(int)
 
     def bump(self, name, n=1):
         """Count a discrete event (no duration) — e.g. how often an
         async gradient push actually overlapped compute vs. blocked, or
         embedding-prefetch hits vs. misses."""
         if self._enabled:
-            self._events[name] += n
+            with self._lock:
+                self._events[name] += n
 
     def counters(self):
-        # list() first: another thread (the serving /statz reader) may
-        # iterate this snapshot while a worker thread keeps bumping.
-        return dict(list(self._events.items()))
+        with self._lock:
+            return dict(self._events)
 
     def observe(self, name, seconds):
         """Record one already-measured duration — for phases whose
@@ -43,17 +58,23 @@ class Timing:
         request's queue wait: enqueued on the request thread, measured
         when the batcher executor picks it up)."""
         if self._enabled:
-            self._totals[name] += seconds
-            self._counts[name] += 1
+            with self._lock:
+                self._totals[name] += seconds
+                self._counts[name] += 1
 
     def start(self, name):
         if self._enabled:
-            self._starts[name] = time.perf_counter()
+            now = time.perf_counter()
+            with self._lock:
+                self._starts[name] = now
 
     def end(self, name):
-        if self._enabled and name in self._starts:
-            self._totals[name] += time.perf_counter() - self._starts.pop(name)
-            self._counts[name] += 1
+        if self._enabled:
+            now = time.perf_counter()
+            with self._lock:
+                if name in self._starts:
+                    self._totals[name] += now - self._starts.pop(name)
+                    self._counts[name] += 1
 
     @contextlib.contextmanager
     def timeit(self, name):
@@ -70,21 +91,18 @@ class Timing:
         ``sync_name`` ("loss_sync"), so this is ~0 when overlap works
         and ->1 when every step stalls on the device.  None until both
         phases have samples' worth of time."""
-        # Two keyed reads, atomic under the GIL — no snapshot needed
-        # (summary()'s snapshot idiom exists because it iterates ALL
-        # entries while writers may add phases).
-        dispatch = self._totals.get(dispatch_name, 0.0)
-        sync = self._totals.get(sync_name, 0.0)
+        with self._lock:
+            dispatch = self._totals.get(dispatch_name, 0.0)
+            sync = self._totals.get(sync_name, 0.0)
         if dispatch + sync <= 0.0:
             return None
         return sync / (dispatch + sync)
 
     def summary(self):
-        # Snapshot both dicts before deriving: a concurrent observer
-        # (serving /statz) must never hit "dict changed size during
-        # iteration" because the executor thread added a phase.
-        totals = dict(list(self._totals.items()))
-        counts = dict(list(self._counts.items()))
+        with self._lock:
+            totals = dict(self._totals)
+            counts = dict(self._counts)
+            events = dict(self._events)
         out = {
             name: {
                 "total_s": totals[name],
@@ -100,7 +118,7 @@ class Timing:
         # phase-only consumers (which iterate {total_s,...} entries)
         # are unaffected elsewhere.
         zero1 = {
-            name: count for name, count in list(self._events.items())
+            name: count for name, count in events.items()
             if name.startswith("zero1_")
         }
         if zero1:
@@ -109,7 +127,7 @@ class Timing:
         # evictions, serving/embedding_service.py), grouped the same
         # way for /statz and bench consumers.
         emb_cache = {
-            name: count for name, count in list(self._events.items())
+            name: count for name, count in events.items()
             if name.startswith("emb_cache.")
         }
         if emb_cache:
@@ -117,19 +135,25 @@ class Timing:
         return out
 
     def report(self):
-        if self._logger is not None:
-            for name, s in sorted(self.summary().items()):
-                if "total_s" not in s:
-                    continue  # counter section (zero1), logged below
-                self._logger.info(
-                    "timing[%s]: total=%.3fs count=%d mean=%.4fs",
-                    name,
-                    s["total_s"],
-                    s["count"],
-                    s["mean_s"],
-                )
-            for name, n in sorted(self._events.items()):
-                self._logger.info("counter[%s]: %d", name, n)
+        if self._logger is None:
+            return
+        # One coherent snapshot for BOTH sections: the counter loop
+        # used to iterate the live events dict and could hit a
+        # concurrent writer's resize mid-report.
+        summary = self.summary()
+        counters = self.counters()
+        for name, s in sorted(summary.items()):
+            if "total_s" not in s:
+                continue  # counter section (zero1), logged below
+            self._logger.info(
+                "timing[%s]: total=%.3fs count=%d mean=%.4fs",
+                name,
+                s["total_s"],
+                s["count"],
+                s["mean_s"],
+            )
+        for name, n in sorted(counters.items()):
+            self._logger.info("counter[%s]: %d", name, n)
 
 
 @contextlib.contextmanager

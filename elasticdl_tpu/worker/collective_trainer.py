@@ -25,6 +25,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 from elasticdl_tpu.utils.timing import Timing
@@ -212,6 +213,17 @@ class CollectiveTrainer(Trainer):
         re-init already cleared the backend and the controller
         snapshotted state to host numpy first.
         """
+        # The elastic re-form as one span in the worker's trace
+        # (docs/observability.md): epoch re-forms, device counts, and
+        # reshard cost line up against the rest of the incident.
+        with tracing.span(
+            "worker.world_reform",
+            devices=0 if mesh is None else mesh.devices.size,
+            zero1=bool(self._zero1),
+        ):
+            self._rebuild_traced(mesh)
+
+    def _rebuild_traced(self, mesh):
         old_zero = self._zero if self._opt_is_flat else None
         self._mesh = mesh
         # Mesh/accum-dependent caches: pad plans bake in the local batch

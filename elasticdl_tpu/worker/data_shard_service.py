@@ -38,10 +38,16 @@ class LocalTask:
 
 class DataShardService:
     def __init__(self, master_client, batch_size=1, wait_poll_secs=0.5,
-                 stop_check=None):
+                 stop_check=None, telemetry_fn=None):
+        """``telemetry_fn``: optional zero-arg callable returning the
+        worker's live telemetry dict (Worker._telemetry_snapshot); its
+        result rides every progress RPC (MasterClient.report_batch_done
+        telemetry fields), so per-worker steps/s reaches the master at
+        exactly the coalesced report cadence — no extra RPCs."""
         self._mc = master_client
         self._batch_size = batch_size
         self._wait_poll_secs = wait_poll_secs
+        self._telemetry_fn = telemetry_fn
         self._lock = threading.Lock()
         self._pending = deque()   # tasks whose records are being consumed
         self._record_count = 0
@@ -66,8 +72,20 @@ class DataShardService:
         and stranding locally-counted records.  The buffer is one
         integer — bounded by construction — with a high-water warning
         so a long outage is visible.  Returns True when sent."""
+        telemetry = None
+        if self._telemetry_fn is not None:
+            try:
+                telemetry = self._telemetry_fn()
+            except Exception as e:  # noqa: BLE001 — telemetry must
+                # never fail a progress report
+                logger.warning("telemetry snapshot failed: %s", e)
         try:
-            self._mc.report_batch_done(count)
+            if telemetry:
+                self._mc.report_batch_done(count, telemetry=telemetry)
+            else:
+                # historical call shape: clients (and test fakes) that
+                # predate the telemetry piggyback keep working
+                self._mc.report_batch_done(count)
             return True
         except Exception as e:  # noqa: BLE001 — outage outlasted retry
             with self._lock:

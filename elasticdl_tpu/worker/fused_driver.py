@@ -56,6 +56,7 @@ from collections import namedtuple
 
 import numpy as np
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -313,6 +314,9 @@ class FusedStepDriver:
                 # counted, requeued with the task.
                 self._fence()
                 self._shard.flush_batch_done()
+                tracing.event("worker.preempt_flush",
+                              steps_run=steps_done - start,
+                              undispatched=len(nxt))
                 return steps_done - start, True
             cur = nxt
         return steps_done - start, False

@@ -19,7 +19,7 @@ if os.environ.get("ELASTICDL_TPU_PLATFORM"):
 
 from elasticdl_tpu.data.factory import create_data_reader
 from elasticdl_tpu.models.spec import load_model_spec
-from elasticdl_tpu.utils import grpc_utils
+from elasticdl_tpu.utils import grpc_utils, tracing
 from elasticdl_tpu.utils.args import parse_worker_args
 from elasticdl_tpu.utils.checkpoint import CheckpointSaver
 from elasticdl_tpu.utils.logging import get_logger
@@ -30,12 +30,19 @@ from elasticdl_tpu.worker.worker import Worker
 logger = get_logger(__name__)
 
 
-def build_worker(args):
-    master_addr = args.master_addr or os.environ.get("MASTER_ADDR", "")
-    worker_id = (
+def resolve_worker_id(args):
+    """Flag wins, env fallback — the ONE resolution both the identity
+    label and the MasterClient registration use (they must never name
+    different workers)."""
+    return (
         args.worker_id if args.worker_id >= 0
         else int(os.environ.get("WORKER_ID", 0))
     )
+
+
+def build_worker(args):
+    master_addr = args.master_addr or os.environ.get("MASTER_ADDR", "")
+    worker_id = resolve_worker_id(args)
     channel = grpc_utils.build_channel(master_addr)
     grpc_utils.connect_to_master(channel, master_addr)
     mc = MasterClient(channel, worker_id=worker_id, addr=master_addr)
@@ -191,6 +198,10 @@ def main(argv=None):
     from elasticdl_tpu.worker.worker import PREEMPTED_EXIT_CODE
 
     args = parse_worker_args(argv)
+    # Structured process identity: every log line (and every flight-
+    # recorder event) of an interleaved drill names its process.
+    worker_id = resolve_worker_id(args)
+    tracing.configure_identity("worker", rank=worker_id)
     logger.info("worker starting: %s", vars(args))
     worker = build_worker(args)
 
@@ -205,6 +216,9 @@ def main(argv=None):
         signal.signal(signal.SIGTERM, _graceful_preempt)
     except ValueError:
         pass  # not the main thread (embedded use)
+    # AFTER the preemption hook so the SIGTERM chain is
+    # dump-ring-then-graceful-preempt ($ELASTICDL_TRACE_DIR gates it).
+    tracing.arm_crash_dump()
     if args.profile_dir:
         from elasticdl_tpu.utils.timing import device_trace
 

@@ -152,10 +152,23 @@ class MasterClient:
             stub.report_task_result, req, "report_task_result", state
         )
 
-    def report_batch_done(self, record_count):
+    def report_batch_done(self, record_count, telemetry=None):
+        """``telemetry``: optional dict of live training health
+        piggybacked on the progress report (docs/observability.md) —
+        keys matching the ReportBatchDoneRequest telemetry fields
+        (steps_per_sec, sync_fraction, push_staleness, window_size,
+        steps_done); unknown keys are ignored."""
         req = pb.ReportBatchDoneRequest(
             worker_id=self.worker_id, record_count=record_count
         )
+        for field in ("steps_per_sec", "sync_fraction",
+                      "push_staleness", "window_size"):
+            value = (telemetry or {}).get(field)
+            if value is not None:
+                setattr(req, field, float(value))
+        steps_done = (telemetry or {}).get("steps_done")
+        if steps_done is not None:
+            req.steps_done = int(steps_done)
         with self._refresh_lock:
             stub = self._stub
             state = {"gen": self._gen}

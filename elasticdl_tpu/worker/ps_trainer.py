@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 from elasticdl_tpu.utils.retry import RetryPolicy
@@ -205,12 +206,24 @@ class ParameterServerTrainer(Trainer):
         # noted were answered by that same full response.
         self._seen_gen_epoch = getattr(self._ps, "generation_epoch", 0)
         self.timing.bump("ps_reconcile")
+        # Flight-recorder breadcrumb inside the current task's trace:
+        # the worker-side half of a PS crash-restart incident
+        # (docs/observability.md span taxonomy).
+        tracing.event("worker.ps_reconcile", dropped_pushes=dropped,
+                      version=self._version,
+                      gen_epoch=self._seen_gen_epoch)
         logger.warning(
             "reconciled PS restart: %d in-flight pushes dropped, "
             "prefetch cache invalidated, dense state re-pulled at "
             "version %d", dropped, self._version,
         )
         return True
+
+    def push_staleness(self):
+        """Depth of the async push pipeline right now — the bounded-
+        staleness telemetry the worker piggybacks on progress RPCs
+        (0 for atomic-sync / serialized jobs)."""
+        return float(len(self._push_inflight))
 
     def _recover_embedding_failure(self, err):
         """An embedding pull failed terminally (the client's retry

@@ -10,6 +10,7 @@ callback task runs model-export callbacks on exactly one worker.
 import time
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.retry import RetryPolicy
 from elasticdl_tpu.utils.timing import Timing
@@ -74,6 +75,10 @@ class Worker:
             # The WAIT poll must abort on graceful preemption — an idle
             # worker's grace window would otherwise expire inside it.
             stop_check=lambda: self._preempt_requested,
+            # Live steps/s + health piggybacked on every progress RPC
+            # (docs/observability.md): the master aggregates these into
+            # its per-job telemetry surface.
+            telemetry_fn=self._telemetry_snapshot,
         )
         self._data_service = TaskDataService(data_reader, spec.feed)
         self.timing = Timing(logger=logger)
@@ -99,6 +104,44 @@ class Worker:
         self._steps = 0
         self._preempt_requested = False
         self.preempted = False
+        # (monotonic mark, steps at mark) for the steps/s telemetry
+        # interval; written and read only on the training thread (the
+        # progress-RPC flush runs there).
+        self._tele_mark = (None, 0)
+
+    def _telemetry_snapshot(self):
+        """Telemetry dict for the next progress RPC: worker-local
+        steps/s over the interval since the previous report,
+        blocked-on-device fraction, PS push-pipeline depth, and the
+        mean fused-window size (docs/observability.md)."""
+        now = time.monotonic()
+        mark_t, mark_steps = self._tele_mark
+        self._tele_mark = (now, self._steps)
+        out = {"steps_done": self._steps}
+        if mark_t is not None and now > mark_t and (
+            self._steps > mark_steps
+        ):
+            out["steps_per_sec"] = (
+                (self._steps - mark_steps) / (now - mark_t)
+            )
+        staleness = getattr(self._trainer, "push_staleness", None)
+        if staleness is not None:
+            out["push_staleness"] = float(staleness())
+        counters = self.timing.counters()
+        windows = counters.get("fused_windows", 0)
+        if windows:
+            out["window_size"] = (
+                counters.get("fused_steps_run", 0) / windows
+            )
+            # Only meaningful on the fused path: the per-step loop
+            # records loss_sync but never window_dispatch, so the
+            # ratio there would read 1.0 ("fully device-stalled") on
+            # every default-config worker regardless of overlap.
+            sync = self.timing.sync_fraction("window_dispatch",
+                                             "loss_sync")
+            if sync is not None:
+                out["sync_fraction"] = sync
+        return out
 
     def request_stop(self):
         """Graceful-preemption hook (SIGTERM handler, worker main):
@@ -378,7 +421,33 @@ class Worker:
             self._elastic.rejoin_world()
         return task
 
+    def _run_one_task(self, task):
+        # One span per task: everything underneath — minibatch RPC
+        # client spans, outage-riding retry events, the master-side
+        # server spans and task.completed breadcrumbs — shares this
+        # trace, so a churn drill reads as one causal timeline.
+        with tracing.span("worker.task", task=task.id,
+                          type=int(task.type)):
+            if task.type == pb.TRAINING:
+                self._train_task(task)
+            elif task.type == pb.EVALUATION:
+                self._evaluate_task(task)
+            elif task.type == pb.PREDICTION:
+                self._predict_task(task)
+            elif task.type == pb.TRAIN_END_CALLBACK:
+                self._train_end_task(task)
+            else:
+                logger.warning("unknown task type %s", task.type)
+                self._shard_service.report_task_done(task)
+
     def run(self):
+        # Root span for the whole run: the worker's single trace id —
+        # task spans nest under it, so even fetch-loop retries during
+        # a master outage land in the same trace.
+        with tracing.span("worker.run", worker=self._mc.worker_id):
+            self._run_traced()
+
+    def _run_traced(self):
         if self._join_rendezvous:
             self._mc.report_train_loop_status(pb.LOOP_START)
         try:
@@ -395,17 +464,7 @@ class Worker:
                         # because the job finished — checkpoint first.
                         raise PreemptedExit()
                     break
-                if task.type == pb.TRAINING:
-                    self._train_task(task)
-                elif task.type == pb.EVALUATION:
-                    self._evaluate_task(task)
-                elif task.type == pb.PREDICTION:
-                    self._predict_task(task)
-                elif task.type == pb.TRAIN_END_CALLBACK:
-                    self._train_end_task(task)
-                else:
-                    logger.warning("unknown task type %s", task.type)
-                    self._shard_service.report_task_done(task)
+                self._run_one_task(task)
         except PreemptedExit:
             self.preempted = True
             logger.warning(

@@ -77,6 +77,22 @@ EDITS = [
     ("PullEmbeddingVectorsRequest", "read_only", 4, F.TYPE_BOOL,
      "readOnly"),
     ("TensorPB", "generation", 5, F.TYPE_INT64, "generation"),
+    # Telemetry piggybacked on the coalesced progress RPC
+    # (docs/observability.md): the worker's live steps/s, blocked-on-
+    # device fraction (Timing.sync_fraction), PS push-pipeline depth,
+    # and mean fused-window size ride the report the worker already
+    # sends every window, so the master's per-job aggregation — the
+    # future resize controller's sensor input — costs zero extra RPCs.
+    ("ReportBatchDoneRequest", "steps_per_sec", 3, F.TYPE_DOUBLE,
+     "stepsPerSec"),
+    ("ReportBatchDoneRequest", "sync_fraction", 4, F.TYPE_DOUBLE,
+     "syncFraction"),
+    ("ReportBatchDoneRequest", "push_staleness", 5, F.TYPE_DOUBLE,
+     "pushStaleness"),
+    ("ReportBatchDoneRequest", "window_size", 6, F.TYPE_DOUBLE,
+     "windowSize"),
+    ("ReportBatchDoneRequest", "steps_done", 7, F.TYPE_INT64,
+     "stepsDone"),
 ]
 
 

@@ -26,7 +26,7 @@ class FakeMasterClient:
         return SimpleNamespace(id=-1, type=pb.NONE, shard=None,
                                model_version=-1)
 
-    def report_batch_done(self, count):
+    def report_batch_done(self, count, telemetry=None):
         pass
 
     def report_task_result(self, task_id, err_message="",

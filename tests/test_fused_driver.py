@@ -56,7 +56,7 @@ class FakeMasterClient:
         return SimpleNamespace(id=-1, type=-1, shard=None,
                                model_version=-1)
 
-    def report_batch_done(self, count):
+    def report_batch_done(self, count, telemetry=None):
         self.batch_done_calls.append(count)
 
     def report_task_result(self, task_id, err_message="",

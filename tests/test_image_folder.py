@@ -109,7 +109,7 @@ def test_elastic_image_folder_consumes_master_indices(folder):
                 model_version=-1,
             )
 
-        def report_batch_done(self, count):
+        def report_batch_done(self, count, telemetry=None):
             pass
 
         def report_task_result(self, *a, **k):

@@ -499,7 +499,7 @@ class FlakyMasterClient:
         return SimpleNamespace(id=-1, type=pb.TRAINING, shard=None,
                                model_version=-1)
 
-    def report_batch_done(self, count):
+    def report_batch_done(self, count, telemetry=None):
         if self.fail_times > 0:
             self.fail_times -= 1
             raise FakeRpcError()

@@ -24,6 +24,11 @@ Per-file rules (each in its own module, registered in ``RULES``):
                           daemonized or joined
   EL007 lifecycle         every ``ThreadPoolExecutor`` must be shut
                           down on its owner's stop path (or handed off)
+  EL009 span-hygiene      a tracing ``start_span`` outside a ``with``
+                          must pair with ``end_span`` in a ``finally``
+                          (its blocking-record half rides EL006: the
+                          blocking registry lists flight-recorder
+                          ``dump`` but not ``record``)
 
 Whole-program rules (``PROGRAM_RULES``, run over the stitched
 ``program.Program`` model of every scanned file):
@@ -69,6 +74,7 @@ from tools.elastic_lint import (  # noqa: E402  (Finding must exist first)
     el003_jit_purity,
     el004_thread_hygiene,
     el007_lifecycle,
+    el009_span_hygiene,
     suppressions,
 )
 from tools.elastic_lint import (  # noqa: E402
@@ -85,6 +91,7 @@ RULES = (
     el003_jit_purity,
     el004_thread_hygiene,
     el007_lifecycle,
+    el009_span_hygiene,
 )
 
 PROGRAM_RULES = (

@@ -37,6 +37,12 @@ QUALIFIED_BLOCKING = {
     ("subprocess", "check_output"): "subprocess.check_output()",
     ("grpc_utils", "wait_for_channel_ready"):
         "grpc_utils.wait_for_channel_ready()",
+    # Flight-recorder DUMPS are file IO (utils/tracing.py); the whole
+    # point of the recorder's design is that record() is safe under
+    # any lock while dump paths never are — this entry is what lets
+    # EL006 prove it (the EL009 family, docs/elastic_lint.md).
+    ("tracing", "dump_now"):
+        "tracing.dump_now() (flight-recorder file IO)",
 }
 
 # -- tier 2: methods that block on any receiver ---------------------------
@@ -82,6 +88,13 @@ _WAIT_NAME_HINTS = ("event", "stopped", "done", "ready", "closed",
 _JOURNAL_TYPES = {"JournalWriter"}
 _JOURNAL_NAME_HINTS = ("journal",)
 _JOURNAL_METHODS = ("append", "flush", "kick", "close")
+# Flight recorder (utils/tracing.py): record() is lock-cheap BY
+# CONTRACT and deliberately absent here; dump() writes a file and must
+# never run while a component lock is held (EL009 family).  Tracer is
+# listed too: tracer.dump() routes to the recorder's file write.
+_RECORDER_TYPES = {"FlightRecorder", "Tracer"}
+_RECORDER_NAME_HINTS = ("recorder", "tracer")
+_RECORDER_BLOCKING_METHODS = ("dump",)
 
 
 def _receiver_name(node):
@@ -147,6 +160,13 @@ def classify_call(call, type_of=None):
             return "journal %s() (journal I/O discipline)" % method
         if method == "append":
             return None
+
+    # tier 3 — flight-recorder dumps (file IO); record() is NOT here
+    # by design, so event-record calls stay legal under locks.
+    if method in _RECORDER_BLOCKING_METHODS:
+        if ctor in _RECORDER_TYPES or (
+                ctor is None and _hinted(name, _RECORDER_NAME_HINTS)):
+            return "flight-recorder %s() (file IO)" % method
 
     # tier 3 — receiver-kind gated
     if method == "result":

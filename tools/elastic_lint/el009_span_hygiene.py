@@ -1,0 +1,105 @@
+"""EL009 — span hygiene: every explicitly-opened span closes on every
+exit path.
+
+The tracing plane (utils/tracing.py) has two span forms.  The context
+manager (``with tracer.span("x"): ...``) closes itself; the explicit
+form (``sp = tracer.start_span("x")`` ... ``tracer.end_span(sp)``)
+exists for spans whose begin and end straddle statements or callbacks
+— and it is exactly the form that leaks: an exception between start
+and end leaves the span open forever, which corrupts the thread's
+context stack (every later event inherits the dead span) and renders
+as an unterminated bar in Perfetto.
+
+The rule: a ``.start_span(...)`` call that is NOT the context
+expression of a ``with`` statement must live in a function that also
+calls ``.end_span(...)`` inside a ``finally`` block.  Matching is
+name-based within the function (the project convention is to start
+and end a span in the same owner); hand a span across functions with
+an inline suppression naming the closer, as with EL004's thread
+ownership handoff.
+
+The other half of the EL009 family — an event-RECORD call that can
+block while a lock is held — rides EL006's machinery: the blocking
+registry (blocking.py) lists the flight recorder's ``dump`` (file IO)
+while deliberately omitting ``record``, so recording under a lock is
+legal and dumping under one is a finding.
+"""
+
+import ast
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL009"
+
+
+def _with_context_calls(tree):
+    """ids of Call nodes used directly as a ``with`` item's context
+    expression (those spans are closed by ``__exit__``)."""
+    managed = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    managed.add(id(item.context_expr))
+    return managed
+
+
+def _is_method_call(call, method):
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == method)
+
+
+def _has_end_span_in_finally(func_node):
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and _is_method_call(
+                            sub, "end_span"):
+                        return True
+    return False
+
+
+def check(tree, source, path):
+    findings = []
+    managed = _with_context_calls(tree)
+
+    funcs = [node for node in ast.walk(tree)
+             if isinstance(node, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))]
+    # Map each start_span call to its innermost enclosing function.
+    owner = {}
+    for func in funcs:
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Call) and _is_method_call(
+                    sub, "start_span"):
+                prev = owner.get(id(sub))
+                # innermost wins: later funcs in walk order may nest
+                # inside earlier ones; pick the smallest span range
+                if prev is None or (
+                        func.lineno >= prev.lineno
+                        and getattr(func, "end_lineno", 1 << 30)
+                        <= getattr(prev, "end_lineno", 1 << 30)):
+                    owner[id(sub)] = func
+
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call) or not _is_method_call(
+                call, "start_span"):
+            continue
+        if id(call) in managed:
+            continue  # the context-manager form closes itself
+        func = owner.get(id(call))
+        where = func.name if func is not None else "<module>"
+        if func is not None and _has_end_span_in_finally(func):
+            continue
+        findings.append(Finding(
+            RULE_ID, path, call.lineno,
+            "%s:start_span:%d" % (where, call.lineno),
+            "start_span outside a `with` must be paired with "
+            "end_span in a `finally` in the same function (an "
+            "exception between start and end leaks the span and "
+            "corrupts the thread's context stack) — use the span() "
+            "context manager, add a try/finally, or suppress naming "
+            "the closer",
+        ))
+    return findings
