@@ -571,6 +571,241 @@ def run_ps_kill_drill(records=1024, deadline_secs=300):
     return out
 
 
+def run_multitenant_drill(records_a=1024, records_b=3072,
+                          deadline_secs=300):
+    """The multi-tenant scheduler drill (docs/scheduler.md): TWO jobs
+    over ONE shared 4-worker pool, with a controller-driven resize and
+    a master SIGKILL landing MID-RESIZE.
+
+    Topology: jobA (small) and jobB (larger) are admitted together and
+    the pool splits 2/2.  jobA finishes first; the resize controller
+    reclaims its workers one per tick (each move a journaled, traced
+    decision).  The drill SIGKILLs the master the moment the FIRST
+    move's ``sched`` record lands in the scheduler journal — the
+    decision is durable, the drained worker's re-register is not — and
+    restarts it with ``--num_workers 0`` on the same port.  The replay
+    must recover the assignment map exactly; the worker still parked
+    on finished jobA then gets a LIVE post-restart resize decision,
+    whose trace must stitch to the worker's re-register + in-place
+    pipeline rebuild.  Gates:
+
+      - both jobs complete with exact per-job record accounting
+        (per-job journal namespaces, ``all_records_accounted`` each)
+      - ZERO worker process restarts: the 4 pool pids at kill time are
+        the only worker pids the drill ever observes
+      - >= 1 controller-driven resize (``sched`` assign with prev != 0)
+      - trace connectivity: one component holds the resize decision
+        (``sched.resize``), the drained worker's re-register
+        (``sched.worker_reassigned``, link_trace) and the worker's
+        in-place rebuild (``worker.job_switch``)"""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from elasticdl_tpu.master.journal import (
+        journal_path,
+        replay_journal,
+        scan_frames,
+    )
+    from elasticdl_tpu.proto import elastic_pb2 as pb
+    from elasticdl_tpu.utils import tracing
+    from elasticdl_tpu.utils.grpc_utils import find_free_port
+
+    records_per_task = 32 * 4
+    expected = {
+        "jobA": -(-records_a // records_per_task),
+        "jobB": -(-records_b // records_per_task),
+    }
+    # Template data origin: distinctive marker for /proc scans; differs
+    # from both jobs so every worker exercises the handshake rebuild.
+    template_origin = "synthetic_mnist:1408"
+    jdir = tempfile.mkdtemp(prefix="edl_mtjournal_")
+    tdir = os.path.join(jdir, "traces")
+    jobs_path = os.path.join(jdir, "jobs.json")
+    with open(jobs_path, "w") as fh:
+        json.dump([
+            {"name": "jobA", "data_origin":
+             "synthetic_mnist:%d" % records_a,
+             "min_workers": 1, "max_workers": 3, "weight": 1.0},
+            {"name": "jobB", "data_origin":
+             "synthetic_mnist:%d" % records_b,
+             "min_workers": 1, "max_workers": 4, "weight": 1.0},
+        ], fh)
+    port = find_free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu", ELASTICDL_TPU_PLATFORM="cpu",
+        ELASTICDL_RPC_DEADLINE_SECS="45",
+        ELASTICDL_TRACE_DIR=tdir,
+    )
+    base_cmd = [
+        sys.executable, "-m", "elasticdl_tpu.master.main",
+        "--jobs_spec", jobs_path,
+        "--model_zoo", "mnist", "--data_origin", template_origin,
+        "--batch_size", "32", "--num_minibatches_per_task", "4",
+        "--num_epochs", "1",
+        "--journal_dir", jdir, "--port", str(port),
+        "--sched_cadence_secs", "0.5",
+    ]
+    sched_dir = os.path.join(jdir, "sched")
+
+    def sched_moves():
+        """Resize decisions journaled so far: assign records whose
+        ``prev`` names a real job — a cross-job MOVE, not a pool
+        registration."""
+        path = journal_path(sched_dir)
+        if not os.path.exists(path):
+            return 0
+        with open(path, "rb") as fh:
+            data = fh.read()
+        return sum(
+            1 for rec, _ in scan_frames(data)
+            if rec.get("ev") == "sched" and rec.get("op") == "assign"
+            and rec.get("prev")
+        )
+
+    def job_completed(job_dir):
+        state = replay_journal(os.path.join(jdir, job_dir))
+        if state is None:
+            return 0, 0
+        return (state.completed_counts.get(int(pb.TRAINING), 0),
+                sum(state.failed_counts.values()))
+
+    out = {"tasks_expected": dict(expected)}
+    log_path = os.path.join(jdir, "drill.log")
+    log_fh = open(log_path, "w")
+    master2 = None
+    master1 = subprocess.Popen(base_cmd + ["--num_workers", "4"],
+                               env=env, stdout=log_fh,
+                               stderr=subprocess.STDOUT, text=True)
+    worker_pids = set()
+
+    def scan_workers():
+        pids = {
+            pid for pid, _ in _scan_procs(
+                template_origin, "elasticdl_tpu.worker.main")
+        }
+        worker_pids.update(pids)
+        return pids
+
+    try:
+        # Wait for the mid-resize moment: jobA drains, the controller's
+        # FIRST reclaim decision lands in the scheduler journal.
+        deadline = time.time() + deadline_secs
+        while time.time() < deadline and sched_moves() < 1:
+            scan_workers()
+            time.sleep(0.1)
+        pids_at_kill = scan_workers()
+        out["workers_at_kill"] = len(pids_at_kill)
+        out["moves_at_kill"] = sched_moves()
+        t_kill = time.perf_counter()
+        master1.send_signal(signal.SIGKILL)
+        master1.wait(timeout=30)
+        if out["moves_at_kill"] < 1:
+            out["error"] = "no resize decision before deadline"
+            return out
+
+        master2 = subprocess.Popen(base_cmd + ["--num_workers", "0"],
+                                   env=env, stdout=log_fh,
+                                   stderr=subprocess.STDOUT, text=True)
+        recovery_secs = None
+        deadline = time.time() + deadline_secs
+        while time.time() < deadline:
+            scan_workers()
+            done_b, _ = job_completed("job-02")
+            if recovery_secs is None and done_b >= expected["jobB"]:
+                recovery_secs = time.perf_counter() - t_kill
+            if master2.poll() is not None:
+                break
+            time.sleep(0.25)
+        if master2.poll() is None:
+            master2.kill()
+            master2.wait(timeout=10)
+            out["error"] = "restarted master did not finish in time"
+        out["master2_exit_code"] = master2.poll()
+        out["recovery_secs"] = (
+            round(recovery_secs, 3) if recovery_secs else None
+        )
+
+        # Per-job exact accounting from each job's journal namespace.
+        accounted = {}
+        for job_dir, name in (("job-01", "jobA"), ("job-02", "jobB")):
+            completed, failed = job_completed(job_dir)
+            accounted[name] = (
+                completed == expected[name] and failed == 0
+            )
+            out["tasks_completed_%s" % name] = completed
+            out["tasks_failed_%s" % name] = failed
+        out["resize_moves_total"] = sched_moves()
+        sched_state = replay_journal(sched_dir)
+        out["restarts_journaled"] = (
+            sched_state.restarts if sched_state else 0
+        )
+
+        # Zero worker process restarts: the pool pids at kill time are
+        # the only worker pids ever observed, and the master log holds
+        # no relaunch decision.
+        log_fh.flush()
+        with open(log_path) as fh:
+            log = fh.read()
+        out["worker_relaunches"] = log.count("relaunch=True")
+        out["worker_pids_observed"] = len(worker_pids)
+        zero_restarts = (
+            out["worker_relaunches"] == 0
+            and worker_pids == pids_at_kill
+            and len(pids_at_kill) == 4
+        )
+        out["zero_worker_restarts"] = zero_restarts
+
+        # Trace gate: master #2's live resize decision + the drained
+        # worker's re-register + its in-place pipeline rebuild in ONE
+        # connected component (master #1's ring died with it — the
+        # post-restart decision is the one that must stitch).
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            dumps = (
+                [] if not os.path.isdir(tdir) else
+                [f for f in os.listdir(tdir)
+                 if f.endswith(".trace.json")]
+            )
+            if len(dumps) >= 2:
+                break
+            time.sleep(0.25)
+        events = tracing.load_dumps(tdir)
+        components = tracing.trace_components(events)
+        required = {"sched.resize", "sched.worker_reassigned",
+                    "worker.job_switch"}
+        out["trace_dumps"] = len(dumps)
+        out["trace_events"] = len(events)
+        out["trace_connected"] = any(
+            required <= {e["name"] for e in c} for c in components
+        )
+
+        out["all_records_accounted"] = (
+            all(accounted.values())
+            and master2.poll() == 0
+            and zero_restarts
+            and out["resize_moves_total"] >= 1
+            and out["trace_connected"]
+        )
+        out["per_job_accounted"] = accounted
+    finally:
+        for proc in (master1, master2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+        log_fh.close()
+        reaped = _reap_orphan_workers(template_origin)
+        if reaped:
+            out["orphan_workers_reaped"] = reaped
+        shutil.rmtree(jdir, ignore_errors=True)
+    return out
+
+
 def main():
     """Three legs (VERDICT r4 #3 — BASELINE.json metric #3 and SURVEY
     §7's named hard part, re-init -> re-shard -> re-compile):
@@ -662,6 +897,20 @@ def main():
         "--async_push_window 2): relaunch+restore at a committed "
         "checkpoint label, generation fencing rejects dead-incarnation "
         "pushes, zero worker relaunches, exact task accounting"
+    )
+    # Multi-tenant leg (docs/scheduler.md): 2 jobs over one shared
+    # 4-worker pool; the resize controller reclaims the finished job's
+    # workers one journaled+traced decision at a time; the master is
+    # SIGKILLed MID-RESIZE and restarted from the sched journal — both
+    # jobs complete with exact per-job accounting, zero worker process
+    # restarts, and the post-restart resize decision stitches to the
+    # drained worker's re-register + in-place rebuild in one trace.
+    legs["cpu_multitenant"] = run_multitenant_drill()
+    legs["cpu_multitenant"]["note"] = (
+        "2 jobs / shared 4-worker pool: controller-driven resize, "
+        "master SIGKILLed mid-resize and restarted from the scheduler "
+        "journal; per-job all_records_accounted, zero worker process "
+        "restarts, decision->re-register trace connectivity"
     )
 
     import bench as _bench  # probe + provenance helpers

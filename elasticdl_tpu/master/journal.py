@@ -69,7 +69,7 @@ _FRAME = struct.Struct("<II")
 # "dispatch", "requeue") is buffered progress riding the next flush.
 DURABLE_EVENTS = frozenset(
     {"meta", "restart", "task", "done", "fail", "trim", "epoch", "cb",
-     "rdzv"}
+     "rdzv", "sched"}
 )
 
 # Keep a bounded progress buffer: one fsync per this many buffered
@@ -317,6 +317,14 @@ class JournalState:
         self.restarts = 0
         self.train_end_pending = False
         self.train_end_created = False
+        # Multi-tenant scheduler records (docs/scheduler.md): the
+        # scheduler journal's "sched" events rebuild the worker->job
+        # assignment map and the per-job admission state, so a master
+        # killed MID-RESIZE replays to a consistent schedule (the
+        # decision is journaled write-ahead of its effects).
+        self.sched_assignments = {}     # worker id -> job id
+        self.sched_jobs = {}            # job id -> {"name", "state"}
+        self.sched_decisions = defaultdict(int)   # op -> count
 
     @property
     def done_ids(self):
@@ -405,8 +413,42 @@ class JournalState:
             self.model_version = max(self.model_version, rec["v"])
         elif ev == "rdzv":
             self.rendezvous_id = max(self.rendezvous_id, rec["n"])
+        elif ev == "sched":
+            self._apply_sched(rec)
         else:
             logger.warning("journal: unknown event %r ignored", ev)
+
+    def _apply_sched(self, rec):
+        """One scheduler decision (record shapes in docs/scheduler.md):
+        submit/admit/finish drive a job's admission state, assign moves
+        a worker between jobs (``prev`` is its old job, 0 = fresh
+        registration), release returns it to the unassigned pool.
+        Later events win — replaying the whole journal yields exactly
+        the assignment map the crashed master had made durable."""
+        op = rec.get("op")
+        if op in ("submit", "admit", "finish", "assign", "release"):
+            # Count only ops this binary knows: a journal from a newer
+            # master may carry future ops, and replayed counters must
+            # match what this master would have counted live.
+            self.sched_decisions[op] += 1
+        if op == "submit":
+            self.sched_jobs[rec["job"]] = {
+                "name": rec.get("name", ""), "state": "pending",
+            }
+        elif op == "admit":
+            self.sched_jobs.setdefault(
+                rec["job"], {"name": "", "state": "pending"}
+            )["state"] = "running"
+        elif op == "finish":
+            self.sched_jobs.setdefault(
+                rec["job"], {"name": "", "state": "running"}
+            )["state"] = "finished"
+        elif op == "assign":
+            self.sched_assignments[rec["w"]] = rec["job"]
+        elif op == "release":
+            self.sched_assignments.pop(rec["w"], None)
+        else:
+            logger.warning("journal: unknown sched op %r ignored", op)
 
     def finish(self):
         """Derived flags after the last event."""

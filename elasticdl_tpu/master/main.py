@@ -33,6 +33,8 @@ _MASTER_ONLY_ARGS = (
     "tpu_topology", "worker_pod_priority", "cluster_spec", "volume",
     "status_port", "journal_dir", "rpc_fault_spec",
     "ps_rpc_fault_spec",
+    "jobs_spec", "sched_cadence_secs", "sched_moves_per_tick",
+    "sched_worker_stale_secs",
 )
 
 # Job-config fields that must match between the journal and a
@@ -331,11 +333,219 @@ def build_master(args):
     return master
 
 
+def _load_jobs_spec(text):
+    """--jobs_spec accepts inline JSON or a path to a JSON file; the
+    value is a list of job-spec dicts (docs/scheduler.md)."""
+    import json
+
+    if os.path.exists(text):
+        with open(text) as fh:
+            text = fh.read()
+    spec = json.loads(text)
+    if not isinstance(spec, list) or not spec:
+        raise ValueError(
+            "--jobs_spec must be a non-empty JSON list of job specs"
+        )
+    return spec
+
+
+def build_multitenant_master(args):
+    """The multi-tenant control plane (master/scheduler.py): J jobs,
+    each with its own task queue, rendezvous epoch space, journal
+    namespace and telemetry aggregate, over ONE shared worker pool
+    driven by the resize controller.  Train-type local/collective jobs
+    only — a PS-mode job keeps its own single-job master."""
+    from elasticdl_tpu.master.journal import (
+        JournalWriter,
+        replay_journal,
+    )
+    from elasticdl_tpu.master.scheduler import (
+        JobRegistry,
+        JobSpec,
+        ManagedJob,
+        MultiTenantMaster,
+        ResizeController,
+    )
+    from elasticdl_tpu.master.servicer import MasterServicer
+
+    specs = [
+        JobSpec.from_dict(entry, defaults=args)
+        for entry in _load_jobs_spec(args.jobs_spec)
+    ]
+    names = [s.name for s in specs]
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate job names in --jobs_spec: %s"
+                         % names)
+    if args.num_workers > 0:
+        # A managed pool never grows past --num_workers, so a job
+        # whose floor exceeds it could NEVER be admitted — the run
+        # would hang forever in the admission queue.  Fail fast.
+        impossible = [
+            s.name for s in specs if s.min_workers > args.num_workers
+        ]
+        if impossible:
+            raise ValueError(
+                "jobs %s require min_workers > --num_workers (%d) "
+                "and could never be admitted" % (impossible,
+                                                 args.num_workers)
+            )
+    sched_journal = None
+    sched_state = None
+    if args.journal_dir:
+        sched_dir = os.path.join(args.journal_dir, "sched")
+        # The recovery trace (same contract as the single-job path):
+        # replaying the scheduler journal is this incarnation's root
+        # recovery span; post-replay events link back to it so worker
+        # outage rides and the restarted schedule stitch into one
+        # incident component (the cpu_multitenant drill gate).
+        with tracing.span("master.journal_replay") as replay_span:
+            sched_state = replay_journal(sched_dir)
+        if sched_state is not None:
+            restart = sched_state.restarts + 1
+            tracing.configure_identity(
+                "master", generation=restart, restart=restart,
+                link_trace=getattr(replay_span, "trace", None),
+            )
+        sched_journal = JournalWriter(sched_dir)
+        sched_meta = {"jobs": names, "multitenant": True}
+        if sched_state is not None:
+            _check_journal_meta(sched_state, sched_meta)
+            sched_journal.append({"ev": "restart"})
+            sched_journal.flush()
+        else:
+            sched_journal.append({"ev": "meta", "job": sched_meta})
+    registry = JobRegistry(
+        journal=sched_journal, pool_size=args.num_workers
+    )
+    for index, spec in enumerate(specs):
+        job_id = index + 1   # deterministic: spec order, 1-based (0 =
+        #                      "unscoped" on the wire)
+        records_per_task = spec.records_per_task
+        reader = create_data_reader(
+            spec.data_origin, records_per_shard=records_per_task
+        )
+        task_manager = TaskManager(
+            training_shards=reader.create_shards(),
+            records_per_task=records_per_task,
+            num_epochs=spec.num_epochs,
+            shuffle=spec.shuffle,
+            shuffle_shards=spec.shuffle_shards,
+            max_task_retries=args.max_task_retries,
+            task_timeout_secs=args.task_timeout_secs,
+            seed=spec.seed,
+        )
+        job_journal = None
+        job_state = None
+        if args.journal_dir:
+            job_dir = os.path.join(args.journal_dir,
+                                   "job-%02d" % job_id)
+            job_state = replay_journal(job_dir)
+            job_journal = JournalWriter(job_dir)
+            if job_state is not None:
+                _check_journal_meta(job_state, spec.journal_meta())
+                task_manager.restore_from_journal(job_state)
+                job_journal.append({"ev": "restart"})
+                job_journal.flush()
+                task_manager.attach_journal(job_journal,
+                                            bootstrap=False)
+            else:
+                job_journal.append(
+                    {"ev": "meta", "job": spec.journal_meta()}
+                )
+                task_manager.attach_journal(job_journal,
+                                            bootstrap=True)
+        rendezvous = None
+        if spec.distribution_strategy == "collective":
+            # Per-job epoch space.  No coordinator factory: pool
+            # workers keep process-local device meshes (the same
+            # regime the elastic drills run); every join/leave still
+            # commits a real journaled epoch for this job only.
+            rendezvous = RendezvousServer(
+                journal=job_journal,
+                initial_epoch=(
+                    job_state.rendezvous_id + 1 if job_state else 0
+                ),
+                name=spec.name,
+            )
+        servicer = MasterServicer(
+            task_manager, rendezvous_server=rendezvous,
+            journal=job_journal, job_id=job_id,
+        )
+        if job_state is not None:
+            servicer.restore_from_journal(job_state)
+        job = ManagedJob(
+            job_id, spec, task_manager, servicer,
+            rendezvous=rendezvous, journal=job_journal,
+        )
+        registry.submit(job, journal=sched_state is None)
+    if sched_state is not None:
+        registry.restore_from_journal(sched_state)
+    worker_manager = None
+    if args.num_workers > 0:
+        worker_args = build_arguments_from_parsed_result(
+            args, filter_args=_MASTER_ONLY_ARGS
+        )
+        worker_manager = WorkerManager(
+            _build_worker_backend(args, worker_args),
+            num_workers=args.num_workers,
+            max_relaunch_count=args.relaunch_on_worker_failure,
+        )
+    controller = ResizeController(
+        registry, worker_manager=worker_manager,
+        cadence_secs=args.sched_cadence_secs,
+        moves_per_tick=args.sched_moves_per_tick,
+        worker_stale_secs=args.sched_worker_stale_secs,
+    )
+    interceptors = None
+    if args.rpc_fault_spec:
+        from elasticdl_tpu.utils.grpc_utils import (
+            FaultInjectionInterceptor,
+        )
+
+        logger.warning(
+            "RPC fault injection armed: %s", args.rpc_fault_spec
+        )
+        interceptors = [FaultInjectionInterceptor(args.rpc_fault_spec)]
+    return MultiTenantMaster(
+        registry, controller, worker_manager=worker_manager,
+        port=args.port, sched_journal=sched_journal,
+        interceptors=interceptors,
+    )
+
+
+def _run_multitenant(args):
+    master = build_multitenant_master(args)
+    master.prepare()
+    status_server = None
+    if args.status_port >= 0:
+        from elasticdl_tpu.master.status_server import (
+            MultiTenantStatusServer,
+        )
+
+        status_server = MultiTenantStatusServer(
+            master.registry, worker_manager=master.worker_manager,
+            port=args.status_port,
+        )
+        status_server.start()
+    try:
+        return master.run()
+    finally:
+        if status_server is not None:
+            status_server.stop()
+        for job in master.registry.jobs():
+            if job.journal is not None:
+                job.journal.close()
+        if master.sched_journal is not None:
+            master.sched_journal.close()
+
+
 def main(argv=None):
     args = parse_master_args(argv)
     tracing.configure_identity("master")
     tracing.arm_crash_dump()
     logger.info("master starting: %s", vars(args))
+    if args.jobs_spec:
+        return _run_multitenant(args)
     master = build_master(args)
     master.prepare()
     status_server = None

@@ -21,7 +21,7 @@ logger = get_logger(__name__)
 
 class RendezvousServer:
     def __init__(self, grace_secs=2.0, coordinator_factory=None,
-                 journal=None, initial_epoch=0):
+                 journal=None, initial_epoch=0, name=""):
         """``coordinator_factory(world_size) -> addr`` (optional): run
         at every epoch commit to stand up that epoch's coordination
         plane — in production ``MasterCoordinationService.start_epoch``
@@ -42,6 +42,10 @@ class RendezvousServer:
         unchanged — defense in depth should the journal tail ever be
         lost to more than a crash), and re-form at the first
         post-restart commit."""
+        # ``name``: log/trace label — under the multi-tenant scheduler
+        # every job owns its own rendezvous epoch space, and interleaved
+        # multi-job logs must name whose epoch committed.
+        self._name = name
         self._lock = threading.Lock()
         self._grace_secs = grace_secs
         self._coordinator_factory = coordinator_factory
@@ -159,14 +163,18 @@ class RendezvousServer:
                 self._coordinator_addr = staged["addr"]
                 self._commit_inflight = False
                 logger.info(
-                    "rendezvous epoch %d: world=%s coordinator=%s",
+                    "rendezvous%s epoch %d: world=%s coordinator=%s",
+                    " [%s]" % self._name if self._name else "",
                     self._rendezvous_id, self._cur_hosts,
                     self._coordinator_addr,
                 )
             # Epoch commits run inside a worker's get_comm_rank server
             # span, so the re-form lands in the polling worker's trace.
-            tracing.event("rendezvous.epoch", epoch=staged["n"],
-                          world_size=len(staged["hosts"]))
+            attrs = {"epoch": staged["n"],
+                     "world_size": len(staged["hosts"])}
+            if self._name:
+                attrs["job"] = self._name
+            tracing.event("rendezvous.epoch", **attrs)
         with self._lock:
             if host in self._cur_hosts:
                 rank = self._cur_hosts.index(host)

@@ -27,7 +27,16 @@ class MasterServicer:
         evaluation_service=None,
         worker_manager=None,
         journal=None,
+        job_id=0,
     ):
+        # Multi-tenant scheduler (master/scheduler.py): each admitted
+        # job gets its OWN MasterServicer, so the per-worker telemetry
+        # aggregation below is keyed per job by construction — two
+        # jobs' workers can never collide in one aggregate.  ``job_id``
+        # makes a misroute loud instead of silent: a progress report
+        # stamped for a different job is dropped, never folded in.
+        # 0 = the single-job master (job scoping off).
+        self._job_id = job_id
         self._task_manager = task_manager
         self._rendezvous = rendezvous_server
         self._evaluation_service = evaluation_service
@@ -128,6 +137,19 @@ class MasterServicer:
 
     @rpc_error_guard
     def report_batch_done(self, request, _context=None):
+        if self._job_id and request.job_id and (
+            request.job_id != self._job_id
+        ):
+            # A shared-pool worker's progress report for a DIFFERENT
+            # job: counting its records (or its steps/s telemetry)
+            # here would corrupt this job's aggregate — the exact
+            # collision the job-scoped proto fields exist to prevent.
+            logger.warning(
+                "progress report for job %d dropped by job %d's "
+                "servicer (routing bug upstream?)",
+                request.job_id, self._job_id,
+            )
+            return pb.Empty()
         with self._lock:
             prev = self.worker_record_counts.get(request.worker_id, 0)
             self.worker_record_counts[request.worker_id] = (

@@ -32,6 +32,7 @@ from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.prom import (  # noqa: F401  (re-exported API)
     fleet_to_prometheus,
+    multitenant_to_prometheus,
     prometheus_line,
     serving_to_prometheus,
     to_prometheus,
@@ -146,4 +147,44 @@ class StatusServer(HttpStatusServer):
                 servicer=servicer,
             ),
             to_prometheus, port=port, host=host,
+        )
+
+
+def collect_multitenant_status(registry, worker_manager=None):
+    """The multi-tenant master's /status payload: the scheduler view
+    (pool, admission queue, assignment map, decision counters) plus a
+    per-job section reusing the single-job surfaces — task counts, the
+    per-job telemetry aggregate (the resize controller's sensor input)
+    and the job's rendezvous epoch (docs/scheduler.md)."""
+    status = {"sched": registry.status(), "jobs": {}}
+    for job in registry.jobs():
+        entry = {
+            "id": job.job_id,
+            "state": job.state,
+            "tasks": job.task_manager.counts(),
+            "finished": job.task_manager.finished(),
+            "telemetry": job.servicer.telemetry(),
+            "exec_counters": dict(job.servicer.worker_exec_counters),
+        }
+        if job.rendezvous is not None:
+            entry["rendezvous"] = {
+                "epoch": job.rendezvous.rendezvous_id,
+                "world": job.rendezvous.world,
+            }
+        status["jobs"][job.spec.name] = entry
+    if worker_manager is not None:
+        status["workers"] = {
+            "live": sorted(worker_manager.live_worker_ids()),
+        }
+    return status
+
+
+class MultiTenantStatusServer(HttpStatusServer):
+    def __init__(self, registry, worker_manager=None, port=0,
+                 host="0.0.0.0"):
+        super().__init__(
+            lambda: collect_multitenant_status(
+                registry, worker_manager=worker_manager,
+            ),
+            multitenant_to_prometheus, port=port, host=host,
         )

@@ -503,6 +503,27 @@ class TaskManager:
         for tid in owned:
             self.report(tid, False, err_message="worker %s died" % worker_id)
 
+    def requeue_worker_tasks(self, worker_id):
+        """Scheduler drain (docs/scheduler.md): hand back every task the
+        worker holds WITHOUT consuming retries — an elastic shrink is
+        not the task's fault, exactly like the observer hand-back on
+        graceful preemption.  The worker may still be mid-task, riding
+        out the re-assignment: when it later reports the requeued task,
+        ``report`` accepts the result from the todo queue (the same
+        replay-safe path a master restart uses).  Returns the ids."""
+        with self._lock:
+            owned = [
+                tid for tid, (wid, _, _) in self._doing.items()
+                if wid == worker_id
+            ]
+        for tid in owned:
+            self.report(
+                tid, False,
+                err_message="worker %s drained by scheduler" % worker_id,
+                requeue=True,
+            )
+        return owned
+
     # -- progress -----------------------------------------------------------
 
     def _finished_training_locked(self):

@@ -145,6 +145,38 @@ def build_master_parser():
                         help="pod volume mounts, reference syntax: "
                              "'claim_name=c,mount_path=/p;"
                              "host_path=/d,mount_path=/p2'")
+    # Multi-tenant scheduler (master/scheduler.py, docs/scheduler.md)
+    parser.add_argument("--jobs_spec", default="",
+                        help="multi-tenant mode: JSON list of job "
+                             "specs (inline, or a path to a .json "
+                             "file) — each entry {name, data_origin, "
+                             "model_zoo, num_epochs, min_workers, "
+                             "max_workers, weight, ...}; unset fields "
+                             "default to this master's own common "
+                             "flags.  The J jobs share one worker "
+                             "pool (--num_workers) under the resize "
+                             "controller; empty = classic single-job "
+                             "master")
+    parser.add_argument("--sched_cadence_secs", type=float, default=1.0,
+                        help="resize-controller policy cadence: each "
+                             "tick sweeps finished jobs, admits "
+                             "queued ones, recomputes per-job worker "
+                             "targets and applies moves")
+    parser.add_argument("--sched_moves_per_tick", type=int, default=1,
+                        help="max worker re-assignments per controller "
+                             "tick — a resize drains one worker at a "
+                             "time by default, each move its own "
+                             "journaled, traced decision")
+    parser.add_argument("--sched_worker_stale_secs", type=float,
+                        default=300.0,
+                        help="a pool worker silent for this long is "
+                             "evicted from the schedule (its tasks "
+                             "requeue without burning retries); "
+                             "bounds ghost assignments after a "
+                             "master restart.  Keep it >= the longest "
+                             "single task: progress/metric reports "
+                             "count as life, but a PREDICTION task "
+                             "reports only at its end")
     return parser
 
 

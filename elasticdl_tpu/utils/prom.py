@@ -35,6 +35,67 @@ def prometheus_line(metric, value, **labels):
     return "%s%s %s" % (metric, label_str, value)
 
 
+def _task_gauges(lines, tasks, finished, **labels):
+    """The per-job task-count gauge block — ONE implementation shared
+    by the single-job renderer (no labels) and the multi-tenant one
+    (job=<name>), so the two can never drift."""
+    lines.append(prometheus_line("elasticdl_tasks_todo",
+                                 tasks["todo"], **labels))
+    lines.append(prometheus_line("elasticdl_tasks_doing",
+                                 tasks["doing"], **labels))
+    lines.append(prometheus_line("elasticdl_data_epoch",
+                                 tasks["epoch"], **labels))
+    for kind in ("completed", "failed"):
+        for task_type, count in tasks[kind].items():
+            lines.append(prometheus_line(
+                "elasticdl_tasks_%s" % kind, count,
+                type=str(task_type), **labels))
+    lines.append(prometheus_line("elasticdl_job_finished",
+                                 int(finished), **labels))
+
+
+def _telemetry_gauges(lines, telemetry, **labels):
+    """Per-job aggregate + per-worker training-health gauges
+    (docs/observability.md) — the resize-controller sensor surface,
+    shared by both master renderers."""
+    if not telemetry:
+        return
+    job = telemetry.get("job", {})
+    if job.get("steps_per_sec") is not None:
+        lines.append(prometheus_line(
+            "elasticdl_job_steps_per_sec",
+            round(job["steps_per_sec"], 3), **labels))
+    lines.append(prometheus_line(
+        "elasticdl_telemetry_workers_reporting",
+        job.get("workers_reporting", 0), **labels))
+    for worker_id, t in sorted(telemetry.get("workers", {}).items()):
+        if not t.get("fresh", True):
+            # Stale workers stay in the /status JSON (with their
+            # age) but leave /metrics: a scraper reading per-worker
+            # gauges must never sum an hours-dead worker's last
+            # steps/s into "live" throughput.
+            continue
+        wl = dict(labels, worker=str(worker_id))
+        lines.append(prometheus_line(
+            "elasticdl_worker_steps_per_sec",
+            round(t.get("steps_per_sec", 0.0), 3), **wl))
+        if t.get("sync_fraction") is not None:
+            lines.append(prometheus_line(
+                "elasticdl_worker_sync_fraction",
+                round(t["sync_fraction"], 4), **wl))
+        if t.get("push_staleness") is not None:
+            lines.append(prometheus_line(
+                "elasticdl_worker_push_staleness",
+                round(t["push_staleness"], 3), **wl))
+        if t.get("window_size") is not None:
+            lines.append(prometheus_line(
+                "elasticdl_worker_window_size",
+                round(t["window_size"], 3), **wl))
+        lines.append(prometheus_line(
+            "elasticdl_worker_steps_done",
+            t.get("steps_done", 0), **wl))
+
+
 def to_prometheus(status):
     """Master /metrics renderer over ``collect_status``'s dict."""
     lines = []
@@ -42,15 +103,7 @@ def to_prometheus(status):
     def gauge(metric, value, **labels):
         lines.append(prometheus_line(metric, value, **labels))
 
-    tasks = status["tasks"]
-    gauge("elasticdl_tasks_todo", tasks["todo"])
-    gauge("elasticdl_tasks_doing", tasks["doing"])
-    gauge("elasticdl_data_epoch", tasks["epoch"])
-    for kind in ("completed", "failed"):
-        for task_type, count in tasks[kind].items():
-            gauge("elasticdl_tasks_%s" % kind, count,
-                  type=str(task_type))
-    gauge("elasticdl_job_finished", int(status["finished"]))
+    _task_gauges(lines, status["tasks"], status["finished"])
     if "workers" in status:
         gauge("elasticdl_workers_live", len(status["workers"]["live"]))
     if "rendezvous" in status:
@@ -67,38 +120,50 @@ def to_prometheus(status):
                   shard["generation"], ps_id=str(ps_id))
             gauge("elasticdl_ps_shard_durable_version",
                   shard["durable_version"], ps_id=str(ps_id))
-    # Per-worker training telemetry piggybacked on the coalesced
-    # progress RPCs (docs/observability.md): the sensor input the
-    # future multi-tenant resize controller reads.
-    telemetry = status.get("telemetry")
-    if telemetry:
-        job = telemetry.get("job", {})
-        if job.get("steps_per_sec") is not None:
-            gauge("elasticdl_job_steps_per_sec",
-                  round(job["steps_per_sec"], 3))
-        gauge("elasticdl_telemetry_workers_reporting",
-              job.get("workers_reporting", 0))
-        for worker_id, t in sorted(telemetry.get("workers", {}).items()):
-            if not t.get("fresh", True):
-                # Stale workers stay in the /status JSON (with their
-                # age) but leave /metrics: a scraper reading per-worker
-                # gauges must never sum an hours-dead worker's last
-                # steps/s into "live" throughput.
-                continue
-            labels = {"worker": str(worker_id)}
-            gauge("elasticdl_worker_steps_per_sec",
-                  round(t.get("steps_per_sec", 0.0), 3), **labels)
-            if t.get("sync_fraction") is not None:
-                gauge("elasticdl_worker_sync_fraction",
-                      round(t["sync_fraction"], 4), **labels)
-            if t.get("push_staleness") is not None:
-                gauge("elasticdl_worker_push_staleness",
-                      round(t["push_staleness"], 3), **labels)
-            if t.get("window_size") is not None:
-                gauge("elasticdl_worker_window_size",
-                      round(t["window_size"], 3), **labels)
-            gauge("elasticdl_worker_steps_done",
-                  t.get("steps_done", 0), **labels)
+    _telemetry_gauges(lines, status.get("telemetry"))
+    return "\n".join(lines) + "\n"
+
+
+def multitenant_to_prometheus(status):
+    """Multi-tenant master /metrics renderer over
+    ``collect_multitenant_status``'s dict (docs/scheduler.md): the
+    scheduler plane (pool size, admission queue depth, decision
+    counters, per-job worker assignment) plus the per-job task and
+    telemetry gauges — the same aggregation keys the single-job
+    /metrics exports, with a ``job`` label."""
+    lines = []
+
+    def gauge(metric, value, **labels):
+        lines.append(prometheus_line(metric, value, **labels))
+
+    sched = status.get("sched", {})
+    gauge("elasticdl_sched_pool_workers", sched.get("pool_workers", 0))
+    gauge("elasticdl_sched_pending_jobs", sched.get("pending_jobs", 0))
+    for op, count in sorted(sched.get("decisions", {}).items()):
+        gauge("elasticdl_sched_decisions_total", count, op=op)
+    assigned = sched.get("workers_assigned", {})
+    for name, jstatus in sorted(status.get("jobs", {}).items()):
+        labels = {"job": name}
+        gauge("elasticdl_sched_workers_assigned",
+              assigned.get(name, 0), **labels)
+        gauge("elasticdl_sched_job_state",
+              {"pending": 0, "running": 1, "finished": 2}.get(
+                  jstatus.get("state"), -1),
+              **labels)
+        _task_gauges(lines, jstatus["tasks"],
+                     jstatus.get("finished", False), **labels)
+        _telemetry_gauges(lines, jstatus.get("telemetry"), **labels)
+        for counter, value in jstatus.get("exec_counters",
+                                          {}).items():
+            gauge("elasticdl_worker_counter", value, name=counter,
+                  **labels)
+        if "rendezvous" in jstatus:
+            gauge("elasticdl_rendezvous_epoch",
+                  jstatus["rendezvous"]["epoch"], **labels)
+            gauge("elasticdl_rendezvous_world_size",
+                  len(jstatus["rendezvous"]["world"]), **labels)
+    if "workers" in status:
+        gauge("elasticdl_workers_live", len(status["workers"]["live"]))
     return "\n".join(lines) + "\n"
 
 

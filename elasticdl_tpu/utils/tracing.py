@@ -476,7 +476,14 @@ def trace_components(events):
         if not trace:
             continue
         parent.setdefault(trace, trace)
-        link = ev.get("link_trace")
+        # Process-wide links land top-level (configure(link_trace=...),
+        # merged into every event); per-event links ride the attrs dict
+        # (event("x", link_trace=...)) — e.g. the scheduler's handover
+        # event linking a drained worker's trace to the resize decision
+        # that moved it (docs/scheduler.md).  Both stitch.
+        link = ev.get("link_trace") or (
+            ev.get("attrs") or {}
+        ).get("link_trace")
         if link:
             union(trace, link)
     groups = {}

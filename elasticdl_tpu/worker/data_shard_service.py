@@ -34,6 +34,12 @@ class LocalTask:
         self.shard = task_pb.shard
         self.size = task_pb.shard.end - task_pb.shard.start
         self.model_version = task_pb.model_version
+        # Owning job under the multi-tenant scheduler (task ids are
+        # only unique per job); 0 = single-job master.  Reports echo
+        # it so a result lands on the dispatching job even after the
+        # worker was re-assigned (docs/scheduler.md).  getattr: test
+        # fakes hand in bare namespaces predating the field.
+        self.job_id = getattr(task_pb, "job_id", 0)
 
 
 class DataShardService:
@@ -58,12 +64,26 @@ class DataShardService:
         # shard auto-completion all flush first, so no progress count
         # is silently lost or double-sent).
         self._deferred_records = 0
+        # Job the deferred counts belong to: the job of the most
+        # recently fetched task (flushes happen at window/task
+        # boundaries, before the next fetch can switch jobs).  Known
+        # at-least-once edge: counts re-buffered by a failed flush and
+        # re-flushed after a re-assignment land on the NEW job —
+        # observability counts only, task accounting stays exact.
+        # 0 = single-job master (field omitted).
+        self._counts_job = 0
         self._stopped = threading.Event()
         self._stop_check = stop_check  # e.g. graceful-preemption flag
         self.exec_counters = {"batch_count": 0, "record_count": 0}
 
     def stop(self):
         self._stopped.set()
+
+    def set_batch_size(self, batch_size):
+        """Multi-tenant job switch: the new job may count records in a
+        different default batch geometry."""
+        with self._lock:
+            self._batch_size = batch_size
 
     def _send_batch_done(self, count):
         """The progress RPC with outage protection: a failed send puts
@@ -79,13 +99,18 @@ class DataShardService:
             except Exception as e:  # noqa: BLE001 — telemetry must
                 # never fail a progress report
                 logger.warning("telemetry snapshot failed: %s", e)
+        kwargs = {}
+        if telemetry:
+            kwargs["telemetry"] = telemetry
+        with self._lock:
+            job = self._counts_job
+        if job:
+            kwargs["job_id"] = job
         try:
-            if telemetry:
-                self._mc.report_batch_done(count, telemetry=telemetry)
-            else:
-                # historical call shape: clients (and test fakes) that
-                # predate the telemetry piggyback keep working
-                self._mc.report_batch_done(count)
+            # historical call shape preserved: clients (and test
+            # fakes) that predate the telemetry/job piggybacks see the
+            # bare positional call
+            self._mc.report_batch_done(count, **kwargs)
             return True
         except Exception as e:  # noqa: BLE001 — outage outlasted retry
             with self._lock:
@@ -124,10 +149,12 @@ class DataShardService:
                         continue
                 return None
             task = LocalTask(task_pb)
-            if task.type == pb.TRAINING:
-                # Only training tasks auto-complete via record counting;
-                # eval/predict/callback tasks are reported explicitly.
-                with self._lock:
+            with self._lock:
+                self._counts_job = task.job_id
+                if task.type == pb.TRAINING:
+                    # Only training tasks auto-complete via record
+                    # counting; eval/predict/callback tasks are
+                    # reported explicitly.
                     self._pending.append(task)
             return task
 
@@ -142,9 +169,9 @@ class DataShardService:
         regardless, so the master's progress counts are current
         whenever its task accounting changes.
         """
-        count = batch_size or self._batch_size
         done = []
         with self._lock:
+            count = batch_size or self._batch_size
             self._deferred_records += count
             self._record_count += count
             self.exec_counters["batch_count"] += 1
@@ -152,7 +179,7 @@ class DataShardService:
             while self._pending and self._record_count >= self._pending[0].size:
                 task = self._pending.popleft()
                 self._record_count -= task.size
-                done.append(task.id)
+                done.append((task.id, task.job_id))
             flush = self._deferred_records if (not defer or done) else 0
             if flush:
                 self._deferred_records = 0
@@ -162,8 +189,10 @@ class DataShardService:
             counters = dict(self.exec_counters) if done else None
         if flush:
             self._send_batch_done(flush)
-        for task_id in done:
-            self._mc.report_task_result(task_id, exec_counters=counters)
+        for task_id, job_id in done:
+            kwargs = {"job_id": job_id} if job_id else {}
+            self._mc.report_task_result(task_id, exec_counters=counters,
+                                        **kwargs)
 
     def flush_batch_done(self):
         """Send any deferred record counts in one RPC (no-op when
@@ -195,8 +224,11 @@ class DataShardService:
                     )
             except ValueError:
                 pass
+        kwargs = {}
+        if task.job_id:
+            kwargs["job_id"] = task.job_id
         self._mc.report_task_result(task.id, err_message=err_message,
-                                    requeue=requeue)
+                                    requeue=requeue, **kwargs)
 
     def report_task_done(self, task):
         self.flush_batch_done()  # progress counts must precede the verdict
@@ -209,7 +241,11 @@ class DataShardService:
             # report_batch_done from other threads, and the gRPC client
             # iterates it during serialization.
             counters = dict(self.exec_counters)
-        self._mc.report_task_result(task.id, exec_counters=counters)
+        kwargs = {}
+        if task.job_id:
+            kwargs["job_id"] = task.job_id
+        self._mc.report_task_result(task.id, exec_counters=counters,
+                                    **kwargs)
 
 
 class RecordIndexService(DataShardService):

@@ -40,6 +40,111 @@ def resolve_worker_id(args):
     )
 
 
+def _build_collective_trainer(args, mc, spec, worker_id,
+                              batch_size=None, checkpoint_dir=None,
+                              checkpoint_steps=None, seed=None,
+                              mesh=None):
+    """The ONE CollectiveTrainer construction path — shared by the
+    eager launch build and the multi-tenant job-switch factory, so a
+    rebuilt worker can never silently train with different settings
+    (checkpoint rules, bf16, zero1, version-report cadence) than a
+    freshly launched one.  The keyword overrides are the job-config
+    fields; everything unset falls back to the launch args."""
+    batch_size = (
+        args.batch_size if batch_size is None else int(batch_size)
+    )
+    checkpoint_dir = (
+        args.checkpoint_dir if checkpoint_dir is None
+        else checkpoint_dir
+    )
+    checkpoint_steps = (
+        args.checkpoint_steps if checkpoint_steps is None
+        else int(checkpoint_steps)
+    )
+    seed = args.seed if seed is None else int(seed)
+    saver = None
+    if checkpoint_dir:
+        saver = CheckpointSaver(
+            checkpoint_dir, keep_max=args.keep_checkpoint_max
+        )
+        if worker_id != 0:
+            # Every worker may restore, but only worker 0 writes (the
+            # collective path replicates params, so any single copy is
+            # the model).
+            checkpoint_steps = 0
+    trainer = CollectiveTrainer(
+        spec,
+        batch_size=batch_size,
+        mesh=mesh,
+        master_client=mc,
+        report_version_steps=max(1, args.evaluation_steps // 4)
+        if args.evaluation_steps else 0,
+        checkpoint_saver=saver,
+        checkpoint_steps=checkpoint_steps,
+        use_bf16_compute=args.use_bf16,
+        rng_seed=seed,
+        zero1=args.zero1,
+    )
+    if saver is not None:
+        trainer.init_from_checkpoint()
+    return trainer
+
+
+def _job_context_factory(args, mc):
+    """Multi-tenant pools (docs/scheduler.md): build the callable the
+    Worker invokes when the scheduler re-assigns it to a different job
+    — rebuilds data reader, model spec and trainer from the handshake
+    config, in place, without a process restart.  Wired for
+    local-strategy pool workers; collective workers keep their elastic
+    controller bound to one trainer, and PS workers keep their PS
+    client topology, so both adopt re-assignments as an id only."""
+    if args.distribution_strategy != "local":
+        return None
+
+    worker_id = resolve_worker_id(args)
+
+    def build(cfg):
+        model_zoo = cfg.get("model_zoo", args.model_zoo)
+        model_params = cfg.get("model_params", args.model_params)
+        batch_size = int(cfg.get("batch_size", args.batch_size))
+        records_per_task = batch_size * int(
+            cfg.get("num_minibatches_per_task",
+                    args.num_minibatches_per_task)
+        )
+        spec = load_model_spec(model_zoo, model_params=model_params)
+        reader = create_data_reader(
+            cfg.get("data_origin", args.data_origin),
+            records_per_shard=records_per_task,
+        )
+        # Job state lives with the job: a worker joining a
+        # checkpointed job resumes that job's trajectory, a worker
+        # joining an uncheckpointed one starts from the job's seeded
+        # init (tenant isolation — nothing rides over from the
+        # previous job's params).
+        trainer = _build_collective_trainer(
+            args, mc, spec, worker_id,
+            batch_size=batch_size,
+            checkpoint_dir=cfg.get("checkpoint_dir"),
+            checkpoint_steps=cfg.get("checkpoint_steps"),
+            seed=cfg.get("seed"),
+        )
+        return reader, spec, trainer
+
+    return build
+
+
+def _initial_job_config(args):
+    """The pool-template config this worker's eagerly-built pipeline
+    corresponds to — lets the first handshake skip the rebuild when
+    the assigned job matches the launch args.  Derived from the ONE
+    field list the fast-path comparison uses, so the two can't
+    drift."""
+    return {
+        field: getattr(args, field)
+        for field in Worker._JOB_KEY_FIELDS
+    }
+
+
 def build_worker(args):
     master_addr = args.master_addr or os.environ.get("MASTER_ADDR", "")
     worker_id = resolve_worker_id(args)
@@ -53,17 +158,6 @@ def build_worker(args):
     reader = create_data_reader(
         args.data_origin, records_per_shard=records_per_task
     )
-    saver = None
-    checkpoint_steps = args.checkpoint_steps
-    if args.checkpoint_dir:
-        saver = CheckpointSaver(
-            args.checkpoint_dir, keep_max=args.keep_checkpoint_max
-        )
-        if worker_id != 0:
-            # Every worker may restore, but only worker 0 writes (the
-            # collective path replicates params, so any single copy is
-            # the model).
-            checkpoint_steps = 0
     if args.job_type == "predict" and spec.prediction_outputs_processor \
             is None:
         from elasticdl_tpu.worker.prediction_outputs_processor import (
@@ -125,21 +219,8 @@ def build_worker(args):
         from jax.sharding import Mesh
 
         mesh = Mesh(np.array(jax.devices()), ("data",))
-    trainer = CollectiveTrainer(
-        spec,
-        batch_size=args.batch_size,
-        mesh=mesh,
-        master_client=mc,
-        report_version_steps=max(1, args.evaluation_steps // 4)
-        if args.evaluation_steps else 0,
-        checkpoint_saver=saver,
-        checkpoint_steps=checkpoint_steps,
-        use_bf16_compute=args.use_bf16,
-        rng_seed=args.seed,
-        zero1=args.zero1,
-    )
-    if saver is not None:
-        trainer.init_from_checkpoint()
+    trainer = _build_collective_trainer(args, mc, spec, worker_id,
+                                        mesh=mesh)
     mem = trainer.zero1_report()
     if mem is not None:
         # Startup accounting for the operator: what one device holds in
@@ -188,6 +269,10 @@ def build_worker(args):
         elastic_controller=elastic,
         fused_steps=args.fused_steps,
         device_prefetch=args.device_prefetch,
+        # Multi-tenant pools: rebuild the pipeline in place when the
+        # scheduler re-assigns this worker to a different job.
+        job_context_factory=_job_context_factory(args, mc),
+        initial_job_config=_initial_job_config(args),
     )
     return worker
 

@@ -17,6 +17,7 @@ inflate the observability counters; task accounting stays exact
 (docs/master_recovery.md, "Known at-least-once edges").
 """
 
+import json
 import os
 import threading
 import time
@@ -71,6 +72,15 @@ class MasterClient:
         )
         self.worker_id = worker_id
         self.worker_host = worker_host or "worker-%d" % worker_id
+        # Multi-tenant scheduler handshake (docs/scheduler.md): the
+        # master's get_task response names the job this worker is
+        # assigned to (0 = single-job master) and, when the assignment
+        # changed, carries the job's worker config.  Subsequent RPCs
+        # echo job_id so reports route to the owning job even across a
+        # re-assignment.  Written only on the task-loop thread (the
+        # one that calls get_task).
+        self.job_id = 0
+        self.job_config = None
 
     @classmethod
     def from_env(cls):
@@ -127,7 +137,8 @@ class MasterClient:
         )
 
     def get_task(self, task_type=None):
-        req = pb.GetTaskRequest(worker_id=self.worker_id)
+        req = pb.GetTaskRequest(worker_id=self.worker_id,
+                                job_id=self.job_id)
         if task_type is not None:
             req.task_type = task_type
         # Snapshot the (stub, generation) pair coherently under the
@@ -136,12 +147,25 @@ class MasterClient:
         with self._refresh_lock:
             stub = self._stub
             state = {"gen": self._gen}
-        return self._call(stub.get_task, req, "get_task", state).task
+        res = self._call(stub.get_task, req, "get_task", state)
+        if res.job_id and res.job_id != self.job_id:
+            # Re-assignment handshake: adopt the new job identity; the
+            # Worker loop reads job_config and rebuilds its pipeline
+            # before processing the first task of the new job.
+            self.job_id = res.job_id
+            if res.job_config:
+                self.job_config = json.loads(res.job_config)
+        return res.task
 
     def report_task_result(self, task_id, err_message="", exec_counters=None,
-                           requeue=False):
+                           requeue=False, job_id=None):
+        """``job_id``: the OWNING job of ``task_id`` (task ids are only
+        unique per job under the multi-tenant scheduler); defaults to
+        the current assignment — callers that report after a
+        re-assignment pass the task's job explicitly."""
         req = pb.ReportTaskResultRequest(
-            task_id=task_id, err_message=err_message, requeue=requeue
+            task_id=task_id, err_message=err_message, requeue=requeue,
+            job_id=self.job_id if job_id is None else job_id,
         )
         for k, v in (exec_counters or {}).items():
             req.exec_counters[k] = int(v)
@@ -152,14 +176,19 @@ class MasterClient:
             stub.report_task_result, req, "report_task_result", state
         )
 
-    def report_batch_done(self, record_count, telemetry=None):
+    def report_batch_done(self, record_count, telemetry=None,
+                          job_id=None):
         """``telemetry``: optional dict of live training health
         piggybacked on the progress report (docs/observability.md) —
         keys matching the ReportBatchDoneRequest telemetry fields
         (steps_per_sec, sync_fraction, push_staleness, window_size,
-        steps_done); unknown keys are ignored."""
+        steps_done); unknown keys are ignored.  ``job_id``: the job
+        these records/telemetry belong to (defaults to the current
+        assignment) — keys the master's per-job aggregate so shared-
+        pool jobs never collide."""
         req = pb.ReportBatchDoneRequest(
-            worker_id=self.worker_id, record_count=record_count
+            worker_id=self.worker_id, record_count=record_count,
+            job_id=self.job_id if job_id is None else job_id,
         )
         for field in ("steps_per_sec", "sync_fraction",
                       "push_staleness", "window_size"):
@@ -175,15 +204,20 @@ class MasterClient:
         self._call(stub.report_batch_done, req, "report_batch_done", state)
 
     def get_comm_rank(self):
-        req = pb.GetCommRankRequest(worker_host=self.worker_host)
+        req = pb.GetCommRankRequest(worker_host=self.worker_host,
+                                    job_id=self.job_id)
         with self._refresh_lock:
             stub = self._stub
             state = {"gen": self._gen}
         return self._call(stub.get_comm_rank, req, "get_comm_rank", state)
 
-    def report_train_loop_status(self, status):
+    def report_train_loop_status(self, status, job_id=None):
+        """``job_id``: which job's world to join/leave — a drained
+        worker LOOP_ENDs its OLD job during the re-assignment
+        handshake; defaults to the current assignment."""
         req = pb.ReportTrainLoopStatusRequest(
-            worker_host=self.worker_host, status=status
+            worker_host=self.worker_host, status=status,
+            job_id=self.job_id if job_id is None else job_id,
         )
         with self._refresh_lock:
             stub = self._stub
@@ -197,6 +231,7 @@ class MasterClient:
                                   model_version=-1):
         req = pb.ReportEvaluationMetricsRequest(
             worker_id=self.worker_id, model_version=model_version,
+            job_id=self.job_id,
         )
         if isinstance(model_outputs, dict):
             for name, arr in model_outputs.items():
@@ -223,7 +258,8 @@ class MasterClient:
         derives the coordinated-checkpoint commit mark from the
         cross-shard min of ``durable_version`` (docs/ps_recovery.md).
         Workers report plain versions and leave the PS fields unset."""
-        req = pb.ReportVersionRequest(model_version=version)
+        req = pb.ReportVersionRequest(model_version=version,
+                                      job_id=self.job_id)
         if ps_id is not None:
             req.is_ps = True
             req.ps_id = int(ps_id)

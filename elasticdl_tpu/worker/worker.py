@@ -45,6 +45,8 @@ class Worker:
         elastic_controller=None,
         fused_steps=1,
         device_prefetch=2,
+        job_context_factory=None,
+        initial_job_config=None,
     ):
         """``elastic_controller`` (ElasticCollectiveController): drives
         the multi-controller collective world from inside the managed
@@ -59,7 +61,15 @@ class Worker:
         classic per-step loop.  ``device_prefetch``: prepared-batch
         lookahead depth for the producer stage; > 0 also stages the
         next window's device transfer behind the running step, 0 keeps
-        batch prep on the dispatch path."""
+        batch prep on the dispatch path.
+
+        ``job_context_factory`` (multi-tenant pools, docs/scheduler.md):
+        ``factory(job_config) -> (data_reader, spec, trainer)`` —
+        called when the scheduler re-assigns this worker to a
+        different job (the get_task handshake), so the worker rebuilds
+        its data pipeline and per-job model state IN PLACE, without a
+        process restart.  None = single-job worker (handshakes are
+        adopted as an id only)."""
         self._mc = master_client
         self._spec = spec
         self._trainer = trainer
@@ -104,6 +114,16 @@ class Worker:
         self._steps = 0
         self._preempt_requested = False
         self.preempted = False
+        # Multi-tenant re-assignment handshake state: the job this
+        # worker's pipeline is currently built for, and the config key
+        # it was built from (an identical config skips the rebuild —
+        # e.g. the pool template already matches the assigned job).
+        self._job_factory = job_context_factory
+        self._job_id = getattr(master_client, "job_id", 0) or 0
+        self._job_key = (
+            self._job_config_key(initial_job_config)
+            if initial_job_config else None
+        )
         # (monotonic mark, steps at mark) for the steps/s telemetry
         # interval; written and read only on the training thread (the
         # progress-RPC flush runs there).
@@ -142,6 +162,85 @@ class Worker:
             if sync is not None:
                 out["sync_fraction"] = sync
         return out
+
+    # Handshake-config fields that change what the worker pipeline is
+    # built from.  Used ONLY for the first-assignment fast path (pool
+    # template already matches the job): cross-job moves always
+    # rebuild, identical config or not — tenant isolation.
+    _JOB_KEY_FIELDS = (
+        "model_zoo", "model_params", "data_origin", "batch_size",
+        "num_minibatches_per_task", "seed", "checkpoint_dir",
+        "distribution_strategy",
+    )
+
+    @classmethod
+    def _job_config_key(cls, cfg):
+        return tuple(
+            (field, cfg.get(field)) for field in cls._JOB_KEY_FIELDS
+        )
+
+    def _maybe_switch_job(self):
+        """The re-assignment handshake (docs/scheduler.md): when the
+        master's get_task response moved this worker to a different
+        job, rebuild the data pipeline / per-job trainer state IN
+        PLACE — the process survives, which is the whole point of the
+        shared pool.  A pipeline-identical config (the pool template
+        matching the assigned job) skips the rebuild."""
+        new_job = getattr(self._mc, "job_id", 0) or 0
+        if not new_job or new_job == self._job_id:
+            return
+        prev_job, self._job_id = self._job_id, new_job
+        cfg = getattr(self._mc, "job_config", None)
+        if self._job_factory is None or not cfg:
+            logger.info(
+                "adopted job %d (no context factory; pipeline kept)",
+                new_job,
+            )
+            return
+        key = self._job_config_key(cfg)
+        if prev_job == 0 and key == self._job_key:
+            # Fast path for the FIRST assignment only: the eagerly
+            # built pool-template pipeline already matches this job,
+            # and no other tenant's state has touched it.  A CROSS-JOB
+            # move always rebuilds even on an identical config —
+            # reusing the trainer would carry the previous tenant's
+            # trained parameters into the new job.
+            logger.info(
+                "registered into job %s (id %d): pool template "
+                "matches, rebuild skipped", cfg.get("job"), new_job,
+            )
+            return
+        # Note: collective pool workers never reach here — their
+        # elastic controller is bound to ONE trainer, so worker/main
+        # wires the factory for local-strategy pools only and
+        # collective workers adopt re-assignments as an id (the
+        # factory-None path above).  Cross-job collective moves would
+        # add LOOP_END(old job)/leave_world before the rebuild and
+        # LOOP_START/rejoin_world after it.
+        with tracing.span("worker.job_switch", job=new_job,
+                          prev_job=prev_job,
+                          job_name=str(cfg.get("job"))):
+            old_trainer = self._trainer
+            if old_trainer is not None and hasattr(old_trainer,
+                                                  "close"):
+                try:
+                    old_trainer.close()
+                except Exception as e:  # noqa: BLE001 — best effort:
+                    # the old job's trainer must not block the new one
+                    logger.warning("old trainer close failed: %s", e)
+            reader, spec, trainer = self._job_factory(cfg)
+            self._spec = spec
+            self._trainer = trainer
+            self._data_service = TaskDataService(reader, spec.feed)
+            batch_size = int(cfg.get("batch_size") or self._batch_size)
+            self._batch_size = batch_size
+            self._shard_service.set_batch_size(batch_size)
+            self._job_key = key
+        logger.info(
+            "switched to job %s (id %d): data=%s model=%s",
+            cfg.get("job"), new_job, cfg.get("data_origin"),
+            cfg.get("model_zoo"),
+        )
 
     def request_stop(self):
         """Graceful-preemption hook (SIGTERM handler, worker main):
@@ -458,6 +557,10 @@ class Worker:
                     task = self._fetch_task_elastic()
                 else:
                     task = self._shard_service.fetch_task()
+                # The get_task that delivered this task may have been
+                # the scheduler's re-assignment handshake: rebuild the
+                # pipeline for the new job BEFORE processing the task.
+                self._maybe_switch_job()
                 if task is None:
                     if self._preempt_requested:
                         # The fetch aborted because of the SIGTERM, not

@@ -93,6 +93,27 @@ EDITS = [
      "windowSize"),
     ("ReportBatchDoneRequest", "steps_done", 7, F.TYPE_INT64,
      "stepsDone"),
+    # Multi-tenant scheduler (docs/scheduler.md): J jobs share one
+    # worker pool, so every control-plane RPC that used to be
+    # implicitly "the job" becomes job-scoped.  Tasks are stamped with
+    # their owning job (task ids are only unique per job), workers
+    # echo the job on results/progress so a report landing after a
+    # re-assignment still routes to the job it belongs to, and the
+    # get_task response carries the assignment (+ the job's worker
+    # config as the re-assignment handshake payload).  0 = single-job
+    # master, all fields ignored.
+    ("TaskPB", "job_id", 5, F.TYPE_INT32, "jobId"),
+    ("GetTaskRequest", "job_id", 3, F.TYPE_INT32, "jobId"),
+    ("GetTaskResponse", "job_id", 2, F.TYPE_INT32, "jobId"),
+    ("GetTaskResponse", "job_config", 3, F.TYPE_STRING, "jobConfig"),
+    ("ReportTaskResultRequest", "job_id", 5, F.TYPE_INT32, "jobId"),
+    ("ReportBatchDoneRequest", "job_id", 8, F.TYPE_INT32, "jobId"),
+    ("GetCommRankRequest", "job_id", 2, F.TYPE_INT32, "jobId"),
+    ("ReportTrainLoopStatusRequest", "job_id", 3, F.TYPE_INT32,
+     "jobId"),
+    ("ReportVersionRequest", "job_id", 6, F.TYPE_INT32, "jobId"),
+    ("ReportEvaluationMetricsRequest", "job_id", 5, F.TYPE_INT32,
+     "jobId"),
 ]
 
 

@@ -126,6 +126,69 @@ def test_truncated_tail_dropped_loudly_not_crash(tmp_path):
     assert state2.status[1] == "done"  # done is absorbing
 
 
+def test_sched_records_roundtrip(tmp_path):
+    """Scheduler decisions (docs/scheduler.md) replay to the exact
+    admission states and worker->job assignment map the crashed master
+    had made durable — including a mid-resize kill, where the decision
+    record landed but the drain's effects did not (they are
+    reconstructed by the per-job restart requeue anyway)."""
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    w.append({"ev": "sched", "op": "submit", "job": 1, "name": "a",
+              "min": 1, "max": 3, "weight": 1.0})
+    w.append({"ev": "sched", "op": "admit", "job": 1})
+    w.append({"ev": "sched", "op": "submit", "job": 2, "name": "b",
+              "min": 1, "max": 0, "weight": 2.0})
+    w.append({"ev": "sched", "op": "admit", "job": 2})
+    w.append({"ev": "sched", "op": "assign", "w": 0, "job": 1,
+              "prev": 0})
+    w.append({"ev": "sched", "op": "assign", "w": 1, "job": 2,
+              "prev": 0})
+    w.append({"ev": "sched", "op": "finish", "job": 1})
+    # the mid-resize decision: worker 0 moved a -> b, then SIGKILL
+    w.append({"ev": "sched", "op": "assign", "w": 0, "job": 2,
+              "prev": 1})
+    w.close()
+    state = replay_journal(jdir)
+    assert state.sched_assignments == {0: 2, 1: 2}
+    assert state.sched_jobs[1] == {"name": "a", "state": "finished"}
+    assert state.sched_jobs[2] == {"name": "b", "state": "running"}
+    assert state.sched_decisions["assign"] == 3
+    assert state.sched_decisions["finish"] == 1
+
+
+def test_sched_release_and_unknown_op_tolerated(tmp_path):
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    w.append({"ev": "sched", "op": "submit", "job": 1, "name": "a"})
+    w.append({"ev": "sched", "op": "assign", "w": 3, "job": 1,
+              "prev": 0})
+    w.append({"ev": "sched", "op": "release", "w": 3, "job": 1,
+              "reason": "exit"})
+    w.append({"ev": "sched", "op": "frobnicate"})   # future record
+    w.close()
+    state = replay_journal(jdir)
+    assert state.sched_assignments == {}
+    assert state.sched_jobs[1]["state"] == "pending"
+
+
+def test_sched_mid_resize_torn_tail_keeps_committed_schedule(tmp_path):
+    """A torn frame exactly at the resize decision leaves the
+    PREVIOUS schedule — never half a decision."""
+    jdir = str(tmp_path)
+    w = JournalWriter(jdir)
+    w.append({"ev": "sched", "op": "submit", "job": 1, "name": "a"})
+    w.append({"ev": "sched", "op": "admit", "job": 1})
+    w.append({"ev": "sched", "op": "assign", "w": 0, "job": 1,
+              "prev": 0})
+    w.close()
+    with open(journal_path(jdir), "ab") as fh:
+        fh.write(b"\x30\x00\x00\x00\x99\x99\x99\x99half-a-decision")
+    state = replay_journal(jdir)
+    assert state.sched_assignments == {0: 1}
+    assert state.sched_jobs[1]["state"] == "running"
+
+
 # -- task manager restart ----------------------------------------------------
 
 def test_restart_requeues_inflight_and_resumes_exactly(tmp_path):

@@ -1,0 +1,646 @@
+"""Multi-tenant elastic scheduler (master/scheduler.py,
+docs/scheduler.md): resize policy invariants (starvation-freedom,
+min-share floors, weighted fairness, admission queueing), job-scoped
+RPC routing over the shared pool, the drain-without-retry-burn shrink
+path, journaled decision replay, and the decision->handover trace
+link."""
+
+import json
+
+import pytest
+
+from elasticdl_tpu.master.journal import JournalWriter, replay_journal
+from elasticdl_tpu.master.rendezvous import RendezvousServer
+from elasticdl_tpu.master.scheduler import (
+    FINISHED,
+    JobRegistry,
+    JobSpec,
+    ManagedJob,
+    MultiTenantServicer,
+    PENDING,
+    RUNNING,
+    ResizeController,
+    compute_targets,
+)
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.status_server import collect_multitenant_status
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import tracing
+from elasticdl_tpu.utils.prom import multitenant_to_prometheus
+
+
+def make_job(job_id, name, n_tasks=4, records_per_task=32,
+             rendezvous=False, journal=None, **spec_kw):
+    spec_kw.setdefault("data_origin", "synthetic_mnist:128")
+    tm = TaskManager(
+        training_shards=[("f", 0, records_per_task * n_tasks)],
+        records_per_task=records_per_task,
+    )
+    if journal is not None:
+        tm.attach_journal(journal, bootstrap=True)
+    spec = JobSpec(name, **spec_kw)
+    rdzv = (
+        RendezvousServer(grace_secs=0.05, name=name)
+        if rendezvous else None
+    )
+    servicer = MasterServicer(tm, rendezvous_server=rdzv,
+                              journal=journal, job_id=job_id)
+    return ManagedJob(job_id, spec, tm, servicer, rendezvous=rdzv,
+                      journal=journal)
+
+
+def make_cluster(jobs_kw, pool_size=4, journal=None, **controller_kw):
+    """registry + controller + servicer over freshly built jobs."""
+    registry = JobRegistry(journal=journal, pool_size=pool_size)
+    jobs = []
+    for index, kw in enumerate(jobs_kw):
+        job = make_job(index + 1, **kw)
+        registry.submit(job)
+        jobs.append(job)
+    controller = ResizeController(registry, **controller_kw)
+    return registry, controller, MultiTenantServicer(registry), jobs
+
+
+# -- resize policy (pure) ----------------------------------------------------
+
+def test_targets_weighted_fair_share():
+    targets = compute_targets(8, [
+        {"id": 1, "min": 1, "max": 0, "weight": 3.0, "demand": 100},
+        {"id": 2, "min": 1, "max": 0, "weight": 1.0, "demand": 100},
+    ])
+    assert targets == {1: 6, 2: 2}          # floors 1+1, surplus 6 split 3:1
+    assert sum(targets.values()) == 8       # work-conserving
+
+
+def test_targets_min_share_floor_beats_weight():
+    # A heavy job cannot starve a light one below its floor.
+    targets = compute_targets(4, [
+        {"id": 1, "min": 1, "max": 0, "weight": 100.0, "demand": 100},
+        {"id": 2, "min": 2, "max": 0, "weight": 0.01, "demand": 100},
+    ])
+    assert targets[2] >= 2
+    assert sum(targets.values()) == 4
+
+
+def test_targets_max_clamp_redistributes():
+    targets = compute_targets(8, [
+        {"id": 1, "min": 1, "max": 2, "weight": 10.0, "demand": 100},
+        {"id": 2, "min": 1, "max": 0, "weight": 1.0, "demand": 100},
+    ])
+    assert targets == {1: 2, 2: 6}          # clamped surplus re-offered
+
+
+def test_targets_demand_caps_allocation():
+    # Never park more workers on a job than it has runnable tasks.
+    targets = compute_targets(8, [
+        {"id": 1, "min": 1, "max": 0, "weight": 1.0, "demand": 2},
+        {"id": 2, "min": 1, "max": 0, "weight": 1.0, "demand": 100},
+    ])
+    assert targets[1] == 2
+    assert targets[2] == 6
+
+
+def test_targets_zero_demand_job_releases_everything():
+    targets = compute_targets(4, [
+        {"id": 1, "min": 2, "max": 0, "weight": 1.0, "demand": 0},
+        {"id": 2, "min": 1, "max": 0, "weight": 1.0, "demand": 10},
+    ])
+    assert targets[1] == 0
+    assert targets[2] == 4
+
+
+def test_targets_starvation_freedom_on_degraded_pool():
+    # Pool shrank below the sum of floors: every job with demand still
+    # gets a worker before any job gets its second.
+    targets = compute_targets(3, [
+        {"id": 1, "min": 2, "max": 0, "weight": 5.0, "demand": 10},
+        {"id": 2, "min": 2, "max": 0, "weight": 1.0, "demand": 10},
+        {"id": 3, "min": 2, "max": 0, "weight": 1.0, "demand": 10},
+    ])
+    assert all(targets[j] >= 1 for j in (1, 2, 3))
+    assert sum(targets.values()) == 3
+
+
+# -- admission control -------------------------------------------------------
+
+def test_admission_queues_job_the_pool_cannot_fit():
+    registry, controller, _sv, jobs = make_cluster(
+        [dict(name="a", min_workers=3),
+         dict(name="b", min_workers=2)],
+        pool_size=4,
+    )
+    assert jobs[0].state == RUNNING
+    assert jobs[1].state == PENDING         # 3 + 2 > 4: queued
+    assert registry.status()["pending_jobs"] == 1
+    # capacity frees when job a finishes -> the queue drains FIFO
+    while True:
+        task = jobs[0].task_manager.get(0)
+        if task is None:
+            break
+        jobs[0].task_manager.report(task.id, True)
+    controller.tick()
+    assert jobs[0].state == FINISHED
+    assert jobs[1].state == RUNNING
+    assert registry.status()["pending_jobs"] == 0
+
+
+def test_admission_is_fifo_never_jumps_the_queue():
+    registry, _ctrl, _sv, jobs = make_cluster(
+        [dict(name="a", min_workers=2),
+         dict(name="b", min_workers=3),     # cannot fit
+         dict(name="c", min_workers=1)],    # COULD fit, but behind b
+        pool_size=4,
+    )
+    assert [j.state for j in jobs] == [RUNNING, PENDING, PENDING]
+    registry.admit_pending()
+    assert [j.state for j in jobs] == [RUNNING, PENDING, PENDING]
+
+
+# -- registration / routing --------------------------------------------------
+
+def test_registration_spreads_workers_by_target_deficit():
+    registry, _ctrl, sv, _jobs = make_cluster(
+        [dict(name="a", n_tasks=8), dict(name="b", n_tasks=8)],
+        pool_size=4,
+    )
+    for wid in range(4):
+        sv.get_task(pb.GetTaskRequest(worker_id=wid))
+    assigned = registry.status()["workers_assigned"]
+    assert assigned == {"a": 2, "b": 2}
+
+
+def test_handshake_carries_job_config_only_on_change():
+    _reg, _ctrl, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=4)], pool_size=1,
+    )
+    res = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    assert res.job_id == jobs[0].job_id
+    assert res.task.job_id == jobs[0].job_id
+    cfg = json.loads(res.job_config)
+    assert cfg["job"] == "a"
+    assert cfg["data_origin"] == "synthetic_mnist:128"
+    # steady state: same assignment echoed back -> no config payload
+    res2 = sv.get_task(
+        pb.GetTaskRequest(worker_id=0, job_id=res.job_id)
+    )
+    assert res2.job_id == jobs[0].job_id
+    assert res2.job_config == ""
+
+
+def test_task_ids_collide_across_jobs_and_route_by_job_id():
+    # Both jobs dispatch a task with id 1: the job-scoped report must
+    # complete each in ITS job, never the other's.
+    _reg, _ctrl, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=2), dict(name="b", n_tasks=2)],
+        pool_size=2,
+    )
+    r0 = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    r1 = sv.get_task(pb.GetTaskRequest(worker_id=1))
+    assert r0.task.id == 1 and r1.task.id == 1
+    assert r0.job_id != r1.job_id
+    sv.report_task_result(
+        pb.ReportTaskResultRequest(task_id=1, job_id=r1.job_id)
+    )
+    by_id = {j.job_id: j for j in jobs}
+    assert by_id[r1.job_id].task_manager.counts()["completed"][
+        int(pb.TRAINING)] == 1
+    assert by_id[r0.job_id].task_manager.counts()["completed"][
+        int(pb.TRAINING)] == 0
+    # unscoped result (job_id 0) is dropped loudly, not guessed
+    sv.report_task_result(pb.ReportTaskResultRequest(task_id=1))
+    assert by_id[r0.job_id].task_manager.counts()["completed"][
+        int(pb.TRAINING)] == 0
+
+
+def test_per_job_telemetry_never_collides_on_worker_id():
+    # The satellite fix: worker id 7 reports progress for BOTH jobs
+    # (externally-launched pools can reuse ids); each job's aggregate
+    # sees only its own series.
+    _reg, _ctrl, sv, jobs = make_cluster(
+        [dict(name="a"), dict(name="b")], pool_size=2,
+    )
+    sv.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=7, record_count=32, job_id=jobs[0].job_id,
+        steps_per_sec=5.0, steps_done=10,
+    ))
+    sv.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=7, record_count=64, job_id=jobs[1].job_id,
+        steps_per_sec=11.0, steps_done=20,
+    ))
+    t_a = jobs[0].servicer.telemetry()
+    t_b = jobs[1].servicer.telemetry()
+    assert t_a["job"]["steps_per_sec"] == pytest.approx(5.0)
+    assert t_b["job"]["steps_per_sec"] == pytest.approx(11.0)
+    assert jobs[0].servicer.worker_record_counts == {7: 32}
+    assert jobs[1].servicer.worker_record_counts == {7: 64}
+
+
+def test_misrouted_progress_report_dropped_by_job_servicer():
+    # Defense in depth below the router: a per-job servicer handed a
+    # report stamped for a DIFFERENT job refuses it.
+    job = make_job(1, "a")
+    job.servicer.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=0, record_count=32, job_id=2, steps_per_sec=3.0,
+        steps_done=5,
+    ))
+    assert job.servicer.worker_record_counts == {}
+    assert job.servicer.telemetry()["workers"] == {}
+
+
+def test_rendezvous_epoch_spaces_are_per_job():
+    _reg, _ctrl, sv, jobs = make_cluster(
+        [dict(name="a", rendezvous=True),
+         dict(name="b", rendezvous=True)],
+        pool_size=2,
+    )
+    a_id, b_id = jobs[0].job_id, jobs[1].job_id
+    sv.report_train_loop_status(pb.ReportTrainLoopStatusRequest(
+        worker_host="worker-0", status=pb.LOOP_START, job_id=a_id))
+    sv.report_train_loop_status(pb.ReportTrainLoopStatusRequest(
+        worker_host="worker-1", status=pb.LOOP_START, job_id=b_id))
+    import time
+    time.sleep(0.1)
+    ra = sv.get_comm_rank(pb.GetCommRankRequest(
+        worker_host="worker-0", job_id=a_id))
+    rb = sv.get_comm_rank(pb.GetCommRankRequest(
+        worker_host="worker-1", job_id=b_id))
+    # each job's world holds only its own worker
+    assert (ra.rank_id, ra.world_size) == (0, 1)
+    assert (rb.rank_id, rb.world_size) == (0, 1)
+    # a worker with no job assignment has no world
+    r_none = sv.get_comm_rank(pb.GetCommRankRequest(
+        worker_host="worker-9"))
+    assert r_none.rank_id == -1
+
+
+# -- the shrink path ---------------------------------------------------------
+
+def drain_job(job, worker_id=99):
+    while True:
+        task = job.task_manager.get(worker_id)
+        if task is None:
+            break
+        job.task_manager.report(task.id, True)
+
+
+def test_drain_requeues_in_flight_task_without_burning_retry():
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=1), dict(name="b", n_tasks=8)],
+        pool_size=2, moves_per_tick=4,
+    )
+    res = sv.get_task(pb.GetTaskRequest(worker_id=0))   # job a's task
+    a = next(j for j in jobs if j.job_id == res.job_id)
+    b = next(j for j in jobs if j.job_id != res.job_id)
+    task_id = res.task.id
+    # controller shrinks job a by force: move its one worker to b
+    controller._apply_move(0, a.job_id, b)
+    counts = a.task_manager.counts()
+    assert counts["todo"] == 1 and counts["doing"] == 0
+    # the task went back WITHOUT a retry charged
+    pending = next(iter(a.task_manager._todo))
+    assert pending.id == task_id and pending.retry_count == 0
+    # the worker, mid-task through the move, reports success late:
+    # accepted from the queue, completed exactly once
+    result = sv.report_task_result(pb.ReportTaskResultRequest(
+        task_id=task_id, job_id=a.job_id))
+    assert result is not None
+    counts = a.task_manager.counts()
+    assert counts["completed"][int(pb.TRAINING)] == 1
+    assert counts["todo"] == 0
+
+
+def test_controller_moves_rate_limited_one_per_tick():
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=2), dict(name="b", n_tasks=12)],
+        pool_size=4, moves_per_tick=1,
+    )
+    held = {}
+    for wid in range(4):
+        res = sv.get_task(pb.GetTaskRequest(worker_id=wid))
+        held[wid] = res
+    a, b = jobs
+    drain_job(a)   # nothing left in job a
+    for wid, res in held.items():
+        if res.job_id == a.job_id and res.task.id > 0:
+            sv.report_task_result(pb.ReportTaskResultRequest(
+                task_id=res.task.id, job_id=a.job_id))
+    m1 = controller.tick()
+    assert a.state == FINISHED
+    assert len(m1) == 1                     # one drained worker per tick
+    m2 = controller.tick()
+    assert len(m2) == 1
+    assert registry.status()["workers_assigned"] == {
+        "a": 0, "b": 4,
+    }
+    # every move is a journal-visible assign decision with prev set
+    assert registry.decision_counts["assign"] >= 6   # 4 regs + 2 moves
+
+
+def test_decision_and_handover_stitch_into_one_trace_component():
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=1), dict(name="b", n_tasks=8)],
+        pool_size=2, moves_per_tick=1,
+    )
+    tracer = tracing.default_tracer()
+    if not tracer.enabled:
+        pytest.skip("tracing disabled in this environment")
+    res = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    a = next(j for j in jobs if j.job_id == res.job_id)
+    b = next(j for j in jobs if j.job_id != res.job_id)
+    sv.report_task_result(pb.ReportTaskResultRequest(
+        task_id=res.task.id, job_id=a.job_id))
+    controller.tick()                       # a finished; move decided
+    # the worker's next poll runs inside its rpc.server span (the
+    # interceptor's role here): the handover event must link back to
+    # the decision's trace
+    with tracer.span("rpc.server/get_task"):
+        res2 = sv.get_task(pb.GetTaskRequest(worker_id=0,
+                                             job_id=a.job_id))
+    assert res2.job_id == b.job_id and res2.job_config
+    components = tracing.trace_components(tracer.recorder.snapshot())
+    linked = [
+        c for c in components
+        if {"sched.resize", "sched.worker_reassigned"} <= {
+            e["name"] for e in c
+        }
+    ]
+    assert linked, "resize decision and worker re-register must share " \
+                   "one connected trace component"
+
+
+# -- journaled decisions + replay -------------------------------------------
+
+def test_sched_records_replay_to_exact_assignment_map(tmp_path):
+    jdir = str(tmp_path / "sched")
+    journal = JournalWriter(jdir)
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=1), dict(name="b", n_tasks=8)],
+        pool_size=2, journal=journal, moves_per_tick=1,
+    )
+    res = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    sv.get_task(pb.GetTaskRequest(worker_id=1))
+    a = next(j for j in jobs if j.job_id == res.job_id)
+    sv.report_task_result(pb.ReportTaskResultRequest(
+        task_id=res.task.id, job_id=a.job_id))
+    moves = controller.tick()
+    assert len(moves) == 1                  # the mid-resize moment:
+    journal.close()                         # crash before further moves
+    state = replay_journal(jdir)
+    # the replayed schedule is exactly what the dying master committed
+    assert state.sched_assignments == {
+        w: j for w, j in
+        ((0, moves[0][2]), (1, registry.status()["assignments"]["1"]))
+    }
+    assert state.sched_jobs[a.job_id]["state"] == FINISHED
+    assert state.sched_decisions["assign"] == 3   # 2 regs + 1 move
+    # a fresh registry (the restarted master) restores the map exactly
+    registry2 = JobRegistry(pool_size=0)
+    jobs2 = [make_job(1, "a", n_tasks=1), make_job(2, "b", n_tasks=8)]
+    for job in jobs2:
+        registry2.submit(job, journal=False)
+    registry2.restore_from_journal(state)
+    assert registry2.status()["assignments"] == (
+        registry.status()["assignments"]
+    )
+    assert [j.state for j in jobs2] == [j.state for j in jobs]
+
+
+def test_sched_journal_write_ahead_of_drain(tmp_path):
+    # commit_move makes the decision durable BEFORE any effect: a
+    # journal closed immediately after commit_move already replays the
+    # new assignment.
+    jdir = str(tmp_path / "sched")
+    journal = JournalWriter(jdir)
+    registry = JobRegistry(journal=journal, pool_size=2)
+    registry.submit(make_job(1, "a"))
+    registry.submit(make_job(2, "b"))
+    registry.ensure_assigned(0)
+    prev = registry.commit_move(0, 2, link="feedbeef")
+    state = replay_journal(jdir)            # no close/flush needed:
+    assert state.sched_assignments == {0: 2}   # commit_move fsync'd
+    assert prev == 1
+    assert registry.pop_link(0) == "feedbeef"
+    assert registry.pop_link(0) is None     # one-shot
+    journal.close()
+
+
+def test_stale_worker_evicted_and_tasks_requeued():
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=4)], pool_size=2,
+        worker_stale_secs=0.0,
+    )
+    res = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    assert res.task.id > 0
+    import time
+    time.sleep(0.01)
+    controller.tick()
+    counts = jobs[0].task_manager.counts()
+    assert counts["doing"] == 0             # requeued, no retry burned
+    assert registry.status()["assignments"] == {}
+
+
+# -- observability surface ---------------------------------------------------
+
+def test_status_and_metrics_surface():
+    registry, _ctrl, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=2, min_workers=2),
+         dict(name="b", n_tasks=2, min_workers=4)],   # queued
+        pool_size=4,
+    )
+    sv.get_task(pb.GetTaskRequest(worker_id=0))
+    sv.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=0, record_count=32, job_id=jobs[0].job_id,
+        steps_per_sec=2.5, steps_done=4,
+    ))
+    status = collect_multitenant_status(registry)
+    assert status["sched"]["pending_jobs"] == 1
+    assert status["jobs"]["a"]["state"] == RUNNING
+    assert status["jobs"]["b"]["state"] == PENDING
+    assert status["jobs"]["a"]["telemetry"]["job"][
+        "steps_per_sec"] == pytest.approx(2.5)
+    text = multitenant_to_prometheus(status)
+    assert 'elasticdl_sched_workers_assigned{job="a"} 1' in text
+    assert 'elasticdl_sched_workers_assigned{job="b"} 0' in text
+    assert "elasticdl_sched_pending_jobs 1" in text
+    assert 'elasticdl_sched_decisions_total{op="assign"} 1' in text
+    assert 'elasticdl_job_steps_per_sec{job="a"} 2.5' in text
+    assert 'elasticdl_tasks_todo{job="a"}' in text
+
+
+def test_handshake_survives_target_job_finishing_before_poll():
+    """A move whose target job drains before the moved worker's first
+    post-move poll must still deliver the config and pop the decision
+    link — the worker would otherwise adopt the new job id with the
+    old pipeline, and the decision trace would never stitch."""
+    registry2, controller2, sv2, jobs2 = make_cluster(
+        [dict(name="a", n_tasks=1), dict(name="b", n_tasks=1),
+         dict(name="c", n_tasks=8, max_workers=1)],
+        pool_size=3, moves_per_tick=4,
+    )
+    held = {w: sv2.get_task(pb.GetTaskRequest(worker_id=w))
+            for w in range(3)}
+    a2 = next(j for j in jobs2 if j.spec.name == "a")
+    b2 = next(j for j in jobs2 if j.spec.name == "b")
+    wid = next(w for w, r in held.items() if r.job_id == a2.job_id)
+    wid_b = next(w for w, r in held.items() if r.job_id == b2.job_id)
+    # both small jobs drain: their holders report their single tasks
+    sv2.report_task_result(pb.ReportTaskResultRequest(
+        task_id=held[wid].task.id, job_id=a2.job_id))
+    sv2.report_task_result(pb.ReportTaskResultRequest(
+        task_id=held[wid_b].task.id, job_id=b2.job_id))
+    # the move lands just before b is swept finished
+    controller2._apply_move(wid, a2.job_id, b2)
+    controller2.tick()   # a and b finished; c at max: nobody moves
+    assert b2.state == FINISHED
+    res3 = sv2.get_task(pb.GetTaskRequest(worker_id=wid,
+                                          job_id=a2.job_id))
+    assert res3.task.type == pb.WAIT        # parked, c still running
+    assert res3.job_id == b2.job_id
+    assert res3.job_config                  # handshake delivered
+    assert registry2.pop_link(wid) is None  # link consumed, not leaked
+
+
+def test_progress_reports_count_as_liveness():
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=4)], pool_size=1,
+        worker_stale_secs=0.05,
+    )
+    res = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    assert res.task.id > 0
+    import time
+    # mid-task: no get_task for longer than the stale window, but
+    # progress reports keep flowing — the sweep must NOT evict
+    for _ in range(3):
+        time.sleep(0.03)
+        sv.report_batch_done(pb.ReportBatchDoneRequest(
+            worker_id=0, record_count=32, job_id=jobs[0].job_id))
+        controller.tick()
+    assert registry.status()["assignments"] == {
+        "0": jobs[0].job_id,
+    }
+    # a released worker's straggler report does not re-open the pool
+    registry.release_worker(0, reason="exit")
+    sv.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=0, record_count=32, job_id=jobs[0].job_id))
+    assert registry.known_worker_count() == 0
+
+
+def test_cross_job_move_rebuilds_even_with_identical_config():
+    """Tenant isolation: the first assignment may reuse the eagerly
+    built pool-template pipeline, but a CROSS-JOB move must rebuild
+    even when the configs are pipeline-identical — the old trainer
+    holds the previous tenant's trained parameters."""
+    from types import SimpleNamespace
+
+    from elasticdl_tpu.worker.worker import Worker
+
+    cfg = {"job": "a", "job_id": 1, "model_zoo": "mnist",
+           "model_params": "", "data_origin": "synthetic_mnist:128",
+           "batch_size": 32, "num_minibatches_per_task": 4, "seed": 0,
+           "checkpoint_dir": "", "distribution_strategy": "local"}
+    builds = []
+
+    def factory(c):
+        builds.append(c["job_id"])
+        return (SimpleNamespace(),
+                SimpleNamespace(feed=None, callbacks=[]),
+                SimpleNamespace())
+
+    mc = SimpleNamespace(job_id=0, job_config=None, worker_id=0)
+    spec = SimpleNamespace(feed=None, callbacks=[])
+    worker = Worker(
+        mc, SimpleNamespace(), spec, None, batch_size=32,
+        job_context_factory=factory, initial_job_config=dict(cfg),
+    )
+    mc.job_id = 1
+    mc.job_config = dict(cfg)
+    worker._maybe_switch_job()
+    assert builds == []                     # template matches: fast path
+    mc.job_id = 2
+    mc.job_config = dict(cfg, job="b", job_id=2)
+    worker._maybe_switch_job()
+    assert builds == [2]                    # identical config, new job:
+    #                                         rebuilt for isolation
+
+
+def test_unassigned_worker_released_on_exit_task():
+    """Pool larger than total demand: workers parked UNASSIGNED must
+    still leave the known set when they collect their exit task, or
+    the unmanaged-pool drain gate would hold the run loop for the
+    full grace window."""
+    registry, controller, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=1, max_workers=1)], pool_size=3,
+    )
+    res0 = sv.get_task(pb.GetTaskRequest(worker_id=0))
+    assert res0.task.id > 0
+    # workers 1..2 park unassigned (job at max): known but jobless
+    for wid in (1, 2):
+        res = sv.get_task(pb.GetTaskRequest(worker_id=wid))
+        assert res.task.type == pb.WAIT
+    assert registry.known_worker_count() == 3
+    sv.report_task_result(pb.ReportTaskResultRequest(
+        task_id=res0.task.id, job_id=jobs[0].job_id))
+    controller.tick()
+    assert registry.all_finished()
+    for wid in range(3):
+        res = sv.get_task(pb.GetTaskRequest(worker_id=wid))
+        assert res.task.id == -1 and res.task.type != pb.WAIT
+    assert registry.known_worker_count() == 0   # drain gate closes
+
+
+def test_impossible_min_workers_fails_fast(tmp_path):
+    import json as _json
+
+    from elasticdl_tpu.master.main import build_multitenant_master
+    from elasticdl_tpu.utils.args import parse_master_args
+
+    spec_path = str(tmp_path / "jobs.json")
+    with open(spec_path, "w") as fh:
+        _json.dump([{"name": "a", "min_workers": 8,
+                     "data_origin": "synthetic_mnist:128"}], fh)
+    args = parse_master_args([
+        "--jobs_spec", spec_path, "--num_workers", "4",
+    ])
+    with pytest.raises(ValueError, match="could never be admitted"):
+        build_multitenant_master(args)
+
+
+def test_multitenant_metrics_include_per_worker_gauges():
+    """The multi-tenant renderer shares the per-job gauge helpers with
+    the single-job one: per-worker health series must appear under a
+    job label, not silently vanish under --jobs_spec."""
+    registry, _ctrl, sv, jobs = make_cluster(
+        [dict(name="a", n_tasks=2)], pool_size=1,
+    )
+    sv.get_task(pb.GetTaskRequest(worker_id=3))
+    sv.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=3, record_count=32, job_id=jobs[0].job_id,
+        steps_per_sec=4.0, sync_fraction=0.25, steps_done=9,
+    ))
+    text = multitenant_to_prometheus(
+        collect_multitenant_status(registry)
+    )
+    assert ('elasticdl_worker_steps_per_sec{job="a",worker="3"} 4.0'
+            in text)
+    assert ('elasticdl_worker_sync_fraction{job="a",worker="3"} 0.25'
+            in text)
+    assert 'elasticdl_worker_steps_done{job="a",worker="3"} 9' in text
+
+
+def test_jobs_spec_validation():
+    with pytest.raises(ValueError):
+        JobSpec("x", min_workers=2, max_workers=1)
+    with pytest.raises(ValueError):
+        JobSpec("x", weight=0)
+    with pytest.raises(ValueError):
+        JobSpec("x", distribution_strategy="ps")
+    with pytest.raises(ValueError):
+        JobSpec.from_dict({"name": "x", "bogus_knob": 1})
+    spec = JobSpec.from_dict(
+        {"name": "x", "min_workers": 0},
+        defaults=type("A", (), {"model_zoo": "mnist",
+                                "data_origin": "synthetic_mnist:64"})(),
+    )
+    assert spec.data_origin == "synthetic_mnist:64"
+    assert spec.min_workers == 0
