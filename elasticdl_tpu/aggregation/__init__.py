@@ -1,0 +1,3 @@
+from elasticdl_tpu.aggregation.aggregator import (  # noqa: F401
+    ModelAggregator,
+)

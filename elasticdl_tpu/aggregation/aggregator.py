@@ -1,0 +1,367 @@
+"""Elastic model-aggregation tier: trainer exports in, servables out.
+
+The piece that closes the online-learning loop ("Elastic Model
+Aggregation with Parameter Service", PAPERS.md arXiv 2204.03211): the
+trainer's ``--export_steps`` hook drops checkpoint-cadence servable
+versions at a SOURCE base; this tier ingests them as they land,
+aggregates across asynchronous/elastic trainer epochs, and publishes
+complete servable versions at a PUBLISH base on a freshness SLO — the
+fleet (serving/router.py + serving/fleet.py) then rolls each published
+version out behind its admission barrier.
+
+Why aggregate at all, instead of pointing the fleet at the trainer's
+exports directly:
+
+ - **Elastic trainers export out of order.**  A re-formed world (a
+   preempted worker 0 relaunching, a multi-tenant re-assignment) can
+   land an export whose version is BELOW one already seen.  Ingest is
+   version-monotone — the same discipline as the serving replica's
+   ``commit_version`` — so a stale export can never publish a
+   regression; it is counted and skipped.
+ - **Asynchronous epochs are noisy.**  One export is one instant of a
+   moving trajectory.  Aggregating over the last W exports (uniform
+   mean, or EMA weighted toward the newest) is the classic
+   online-learning smoothing: the published model changes continuously
+   instead of jumping with every cadence tick.
+ - **Publish cadence decouples from export cadence.**  The trainer
+   exports as fast as its cadence fires; the fleet pays a prepare→
+   warm→barrier→commit rollout per published version.  The publisher
+   throttles to ``min_publish_interval_secs`` while the freshness SLO
+   (``freshness_slo_secs``) bounds how stale the serving fleet may get
+   — both observable on the router's /metrics
+   (``elasticdl_agg_freshness_seconds``).
+
+Publishing reuses the source export's StableHLO program and manifest
+(the program depends on the model function, not the weight values) and
+writes through ``serving.export.publish_export`` — atomic tmp-dir +
+fsync + rename, so the fleet coordinator's scanner never sees a torn
+version.  Retention (``export_keep``) GCs old published versions but
+NEVER the fleet's committed version or anything newer.
+
+Single-threaded by design: one aggregator loop owns ingest, aggregate,
+publish, and GC (aggregation/main.py drives it; the bench drives it in
+process).  ``stats()`` is the only cross-thread surface and is
+lock-guarded; no lock is ever held across file or HTTP IO.
+"""
+
+import collections
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from elasticdl_tpu.serving.export import _npz_bytes, publish_export
+from elasticdl_tpu.serving.loader import list_versions
+from elasticdl_tpu.utils import tracing
+from elasticdl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class _Ingest:
+    """One ingested trainer export."""
+
+    __slots__ = ("version", "dense", "embeddings", "export_dir",
+                 "born_at")
+
+    def __init__(self, version, dense, embeddings, export_dir,
+                 born_at):
+        self.version = version
+        self.dense = dense
+        self.embeddings = embeddings
+        self.export_dir = export_dir
+        self.born_at = born_at
+
+
+class ModelAggregator:
+    def __init__(self, source_dir, publish_dir, window=4, mode="ema",
+                 ema_decay=0.5, freshness_slo_secs=10.0,
+                 min_publish_interval_secs=0.0, export_keep=0,
+                 model_name=""):
+        if mode not in ("ema", "mean", "latest"):
+            raise ValueError("unknown aggregation mode %r "
+                             "(ema|mean|latest)" % (mode,))
+        if not 0.0 < ema_decay < 1.0 and mode == "ema":
+            raise ValueError("ema_decay must be in (0, 1)")
+        self.source_dir = source_dir
+        self.publish_dir = publish_dir
+        self.window = max(1, int(window))
+        self.mode = mode
+        self.ema_decay = float(ema_decay)
+        self.freshness_slo_secs = float(freshness_slo_secs)
+        self.min_publish_interval_secs = float(
+            min_publish_interval_secs)
+        self.export_keep = int(export_keep)
+        self.model_name = model_name
+        self._window = collections.deque(maxlen=self.window)
+        self._last_ingested = 0
+        self._ingested_set = set()  # pruned to on-disk versions
+        self._last_published = 0
+        self._last_publish_at = None   # monotonic
+        self._program = None           # cached model.stablehlo bytes
+        self._program_params = None    # manifest["parameters"] it fits
+        # stats() is read from other threads (the router forwards
+        # freshness onto /metrics, tests poll); everything else is
+        # single-threaded.  The lock guards ONLY these numbers — never
+        # held across IO.
+        self._stats_lock = threading.Lock()
+        self._counters = collections.Counter()
+        self._freshness = None
+
+    # -- cross-thread surface ------------------------------------------
+
+    def bump(self, name, n=1):
+        with self._stats_lock:
+            self._counters[name] += n
+
+    def stats(self):
+        with self._stats_lock:
+            counters = dict(self._counters)
+            freshness = self._freshness
+        # The version/window fields are single-writer (the aggregator
+        # loop) and GIL-atomic to read — only the multi-writer
+        # counters and the freshness gauge need the lock.
+        return {
+            "last_ingested_version": self._last_ingested,
+            "last_published_version": self._last_published,
+            "window_fill": len(self._window),
+            "freshness_seconds": freshness,
+            "freshness_slo_secs": self.freshness_slo_secs,
+            "counters": counters,
+        }
+
+    # -- ingest --------------------------------------------------------
+
+    def ingest_once(self):
+        """Scan the source base; ingest every new COMPLETE version in
+        order.  Returns the list of versions ingested this pass.
+
+        Version-monotone: an export at or below the high-water mark —
+        a re-formed elastic world flushing an out-of-order cadence —
+        is skipped and counted (``stale_exports_skipped``), exactly
+        the ``commit_version`` regression rule on the serving side, so
+        a late straggler can never roll the published model back."""
+        from elasticdl_tpu.serving.export import load_payload
+
+        try:
+            versions = list_versions(self.source_dir)
+        except OSError as e:
+            logger.warning("source scan failed: %s", e)
+            return []
+        # Bounded memory: once a version leaves the source base (the
+        # trainer's own retention), it leaves this set too — and the
+        # monotone high-water mark keeps a re-appearance unreachable.
+        self._ingested_set &= set(versions)
+        stale = [v for v in versions if v <= self._last_ingested
+                 and v not in self._ingested_set]
+        if stale:
+            # Out-of-order arrivals from a re-formed world: counted
+            # ONCE (added to the set below), never ingested.
+            self._ingested_set.update(stale)
+            self.bump("stale_exports_skipped", len(stale))
+        ingested = []
+        for version in versions:
+            if version <= self._last_ingested:
+                continue
+            export_dir = os.path.join(self.source_dir, str(version))
+            with tracing.span("agg.ingest", version=version):
+                try:
+                    dense, embeddings = load_payload(export_dir)
+                    born_at = os.path.getmtime(
+                        os.path.join(export_dir, "manifest.json"))
+                except (OSError, ValueError, KeyError) as e:
+                    # A GC'd or unreadable export: skip loudly; the
+                    # next trainer cadence brings a fresh one.
+                    logger.warning("ingest of version %d failed: %s",
+                                   version, e)
+                    self.bump("ingest_errors")
+                    continue
+                self._window.append(_Ingest(
+                    version, dense, embeddings, export_dir, born_at))
+                self._last_ingested = version
+                self._ingested_set.add(version)
+                ingested.append(version)
+                self.bump("ingested")
+        return ingested
+
+    # -- aggregate -----------------------------------------------------
+
+    def aggregated_dense(self):
+        """Version-deduped weighted combine over the ingest window.
+
+        ``ema``: weights decay^age normalized (newest heaviest) —
+        publish trajectory is a smoothed copy of the trainer's.
+        ``mean``: uniform over the window.  ``latest``: newest export
+        verbatim (aggregation off, the comparison baseline).  Only
+        float leaves combine; integer leaves (ids, counters) ride from
+        the newest export.  Embeddings always ride from the newest —
+        averaging sparse rows that may not exist in every export would
+        fabricate values."""
+        if not self._window:
+            raise RuntimeError("nothing ingested yet")
+        newest = self._window[-1]
+        if self.mode == "latest" or len(self._window) == 1:
+            return dict(newest.dense)
+        members = list(self._window)
+        if self.mode == "ema":
+            weights = [self.ema_decay ** (len(members) - 1 - i)
+                       for i in range(len(members))]
+        else:
+            weights = [1.0] * len(members)
+        total = sum(weights)
+        weights = [w / total for w in weights]
+        out = {}
+        for name, newest_leaf in newest.dense.items():
+            newest_leaf = np.asarray(newest_leaf)
+            if not np.issubdtype(newest_leaf.dtype, np.floating):
+                out[name] = newest_leaf
+                continue
+            acc = np.zeros_like(newest_leaf, dtype=np.float64)
+            ok = True
+            for member, w in zip(members, weights):
+                leaf = member.dense.get(name)
+                if leaf is None or np.shape(leaf) != newest_leaf.shape:
+                    ok = False
+                    break
+                acc += w * np.asarray(leaf, np.float64)
+            # A window member missing the leaf (a model change mid-
+            # window): the newest value wins whole — never an average
+            # over mismatched trees.
+            out[name] = (acc.astype(newest_leaf.dtype) if ok
+                         else newest_leaf)
+        return out
+
+    # -- publish -------------------------------------------------------
+
+    def publish_due(self, now=None):
+        """A new ingest is waiting and the publish throttle allows."""
+        if not self._window or self._last_ingested <= \
+                self._last_published:
+            return False
+        if self._last_publish_at is None:
+            return True
+        now = time.monotonic() if now is None else now
+        return (now - self._last_publish_at
+                >= self.min_publish_interval_secs)
+
+    def publish(self):
+        """Write the aggregated servable as
+        ``<publish_dir>/<newest ingested version>/`` (atomic).  Returns
+        (version, freshness_seconds): freshness is publish wall time
+        minus the newest source export's birth time — the number the
+        SLO constrains and /metrics exports."""
+        newest = self._window[-1]
+        version = newest.version
+        dst = os.path.join(self.publish_dir, str(version))
+        if os.path.isfile(os.path.join(dst, "manifest.json")):
+            # A restarted aggregator replaying its ingest state:
+            # version already published (complete versions are
+            # immutable — rewriting one would ride the non-atomic
+            # swap path over a dir the fleet may have committed).
+            self._last_published = version
+            self._last_publish_at = time.monotonic()
+            self.bump("republish_skipped")
+            logger.info("version %d already published; skipped",
+                        version)
+            return version, max(0.0, time.time() - newest.born_at)
+        with tracing.span("agg.publish", version=version,
+                          window=len(self._window), mode=self.mode):
+            dense = self.aggregated_dense()
+            program, manifest = self._program_for(newest)
+            manifest = dict(
+                manifest, version=version,
+                model_name=self.model_name
+                or manifest.get("model_name", ""),
+            )
+            manifest["aggregation"] = {
+                "mode": self.mode,
+                "window": len(self._window),
+                "source_versions": [i.version for i in self._window],
+                "ema_decay": (self.ema_decay if self.mode == "ema"
+                              else None),
+            }
+            payload = dict(dense)
+            for name, (ids, values) in newest.embeddings.items():
+                payload["emb_ids/" + name] = ids
+                payload["emb_vals/" + name] = np.asarray(values)
+            # The aggregate is plain f32 — strip any int8 storage
+            # prefix the SOURCE manifest carried (quantized trainer
+            # exports decode at ingest; the published npz holds full
+            # weights).
+            fmt = manifest.get("format", "")
+            manifest["format"] = fmt.split("+")[-1]
+            manifest["quantized_int8"] = []
+            publish_export(
+                os.path.join(self.publish_dir, str(version)), {
+                    "model.npz": _npz_bytes(payload),
+                    "model.stablehlo": program,
+                    "manifest.json": json.dumps(
+                        manifest, indent=2).encode(),
+                })
+        freshness = max(0.0, time.time() - newest.born_at)
+        self._last_published = version
+        self._last_publish_at = time.monotonic()
+        with self._stats_lock:
+            self._freshness = freshness
+            self._counters["published"] += 1
+        if freshness > self.freshness_slo_secs:
+            self.bump("slo_misses")
+            logger.warning(
+                "publish freshness %.2fs exceeds SLO %.2fs "
+                "(version %d)", freshness, self.freshness_slo_secs,
+                version)
+        logger.info("published aggregated version %d (window %d, "
+                    "mode %s, freshness %.2fs)", version,
+                    len(self._window), self.mode, freshness)
+        return version, freshness
+
+    def _program_for(self, ingest):
+        """(program bytes, manifest dict) for a publish — the StableHLO
+        program depends on the model function and the parameter
+        SHAPES/DTYPES (not the weight values), so it is read once and
+        reused until the tree changes.  The cache key must carry
+        shapes, not just names: a resized layer keeps its flat name
+        but needs the re-traced program its own export carries."""
+        with open(os.path.join(ingest.export_dir,
+                               "manifest.json")) as f:
+            manifest = json.load(f)
+        params_key = {
+            name: (tuple(np.shape(leaf)),
+                   str(np.asarray(leaf).dtype))
+            for name, leaf in ingest.dense.items()
+        }
+        if self._program is None or params_key != self._program_params:
+            with open(os.path.join(ingest.export_dir,
+                                   "model.stablehlo"), "rb") as f:
+                self._program = f.read()
+            self._program_params = params_key
+        return self._program, manifest
+
+    # -- retention -----------------------------------------------------
+
+    def gc_published(self, committed_floor=None):
+        """Retention over the publish base: keep the newest
+        ``export_keep`` versions; NEVER remove the fleet's committed
+        version or anything newer (``committed_floor``) — a canary
+        rollback or a healing rejoiner must always find them.  With an
+        unknown floor nothing is removed (safe default).  Also reaps
+        ``.tmp-*`` staging leftovers (``list_versions`` gc).  Returns
+        the versions removed."""
+        if not self.export_keep or committed_floor is None:
+            return []
+        versions = list_versions(self.publish_dir, gc_incomplete=True)
+        removable = [v for v in versions[:-self.export_keep]
+                     if v < int(committed_floor)]
+        for version in removable:
+            shutil.rmtree(
+                os.path.join(self.publish_dir, str(version)),
+                ignore_errors=True)
+        if removable:
+            self.bump("gc_removed", len(removable))
+            logger.info("retention GC removed versions %s (keep %d, "
+                        "committed floor %s)", removable,
+                        self.export_keep, committed_floor)
+        return removable

@@ -23,8 +23,10 @@ Anything that can read npz + deserialize StableHLO can serve the model —
 NOTHING from the training framework (master/worker/ps).
 """
 
+import io
 import json
 import os
+import shutil
 
 import numpy as np
 
@@ -124,6 +126,103 @@ def load_payload(export_dir):
     return dense, embeddings
 
 
+def _fsync_dir(path):
+    dirfd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def publish_export(export_dir, files):
+    """Atomically materialize ``files`` ({name: bytes}) as ``export_dir``.
+
+    The export publish used to write leaf files then manifest.json
+    directly into the final directory — a writer crash mid-export left
+    a manifest-less version dir that every scanner
+    (``loader.list_versions``, the fleet coordinator, the aggregation
+    tier) had to skip forever.  Instead: stage into a
+    ``<dir>.tmp-<pid>`` sibling, fsync every file AND the staged dir,
+    then ``os.rename`` into place and fsync the parent — the
+    ``establish_generation`` durability idiom (ps/server.py).  A crash
+    at any instant leaves either no version dir or a complete one,
+    never a torn one; the only possible leftovers are ``.tmp-*``
+    siblings, which ``loader.list_versions(gc_incomplete=True)``
+    reaps.
+
+    An EXISTING non-empty ``export_dir`` (a flat-layout re-export over
+    the same path) is swapped out whole: old renamed aside to
+    ``<dir>.old-<pid>``, fresh renamed in, old removed.  The swap is
+    NOT single-rename-atomic — a crash between the two renames leaves
+    the export visible only as the ``.old-`` sibling (which
+    ``gc_incomplete`` deliberately never reaps) — so VERSIONED
+    publishers never take it: a complete ``<base>/<N>/`` is immutable,
+    and re-publishing one (an aggregator restart replaying its ingest
+    state) is an idempotent skip at the caller.
+    """
+    export_dir = os.path.normpath(export_dir)
+    parent = os.path.dirname(export_dir) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = "%s.tmp-%d" % (export_dir, os.getpid())
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    try:
+        for name, blob in files.items():
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        try:
+            os.rename(tmp, export_dir)
+        except OSError:
+            # Destination exists and is non-empty (os.rename adopts an
+            # EMPTY dir fine): swap it out whole.
+            old = "%s.old-%d" % (export_dir, os.getpid())
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(export_dir, old)
+            os.rename(tmp, export_dir)
+            shutil.rmtree(old, ignore_errors=True)
+        _fsync_dir(parent)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def _npz_bytes(payload):
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def _encode_embeddings(payload, embeddings, quantize):
+    """Add embedding tables to a payload dict; returns (table names,
+    emb-quantized manifest entries).  The ONE embedding encoder: the
+    full export and the ContinuousExporter's program-reuse path must
+    write byte-compatible encodings or the cached manifest lies.
+    ``emb_quantized`` stays SEPARATE from the dense list: each format
+    prefix must reflect exactly the encodings present in the file."""
+    table_names = []
+    emb_quantized = []
+    for name, (ids, values) in (embeddings or {}).items():
+        payload["emb_ids/" + name] = ids
+        values = np.asarray(values)
+        if quantize == "int8" and values.ndim == 2 and (
+            values.dtype == np.float32
+            and values.size >= QUANTIZE_MIN_ELEMS
+        ):
+            # Embedding tables dominate CTR-model artifacts; the same
+            # per-row symmetric int8 applies (rows are the channels).
+            q, scale = _quantize_rows(values)
+            payload["q8emb/" + name] = q
+            payload["q8embscale/" + name] = scale
+            emb_quantized.append("emb:" + name)
+        else:
+            payload["emb_vals/" + name] = values
+        table_names.append(name)
+    return table_names, emb_quantized
+
+
 def export_servable(export_dir, apply_fn, params, example_input,
                     model_name="", version=0, embeddings=None,
                     dense_overrides=None, platforms=("cpu", "tpu"),
@@ -150,7 +249,6 @@ def export_servable(export_dir, apply_fn, params, example_input,
     import jax
     from jax import export as jax_export
 
-    os.makedirs(export_dir, exist_ok=True)
     params = to_numpy(params)
     flat, treedef = flatten_with_names(params)
     for name, value in (dense_overrides or {}).items():
@@ -226,29 +324,8 @@ def export_servable(export_dir, apply_fn, params, example_input,
                          % (quantize,))
     else:
         payload = dict(flat)
-    table_names = []
-    emb_quantized = []  # SEPARATE from the dense list: each format
-    # prefix must reflect exactly the encodings present in the file
-    for name, (ids, values) in (embeddings or {}).items():
-        payload["emb_ids/" + name] = ids
-        values = np.asarray(values)
-        if quantize == "int8" and values.ndim == 2 and (
-            values.dtype == np.float32
-            and values.size >= QUANTIZE_MIN_ELEMS
-        ):
-            # Embedding tables dominate CTR-model artifacts; the same
-            # per-row symmetric int8 applies (rows are the channels).
-            q, scale = _quantize_rows(values)
-            payload["q8emb/" + name] = q
-            payload["q8embscale/" + name] = scale
-            emb_quantized.append("emb:" + name)
-        else:
-            payload["emb_vals/" + name] = values
-        table_names.append(name)
-    with open(os.path.join(export_dir, "model.npz"), "wb") as f:
-        np.savez(f, **payload)
-    with open(os.path.join(export_dir, "model.stablehlo"), "wb") as f:
-        f.write(exported.serialize())
+    table_names, emb_quantized = _encode_embeddings(
+        payload, embeddings, quantize)
     signature = _signature(example_input)
     if poly:
         # Truthful metadata: the leading dim is symbolic, not the
@@ -314,8 +391,129 @@ def export_servable(export_dir, apply_fn, params, example_input,
         "output_signature": output_signature,
         "loader": "elasticdl_tpu.serving.loader:load_servable",
     }
-    with open(os.path.join(export_dir, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
+    publish_export(export_dir, {
+        "model.npz": _npz_bytes(payload),
+        "model.stablehlo": exported.serialize(),
+        "manifest.json": json.dumps(manifest, indent=2).encode(),
+    })
     logger.info("servable export at %s (%d tensors, %d tables)",
                 export_dir, len(flat), len(table_names))
     return manifest
+
+
+class ContinuousExporter:
+    """Checkpoint-cadence servable exports for the online-learning loop
+    (docs/serving.md "The online loop").
+
+    The trainer's ``--export_steps`` hook calls :meth:`export` every N
+    optimizer steps; each call lands a COMPLETE versioned servable at
+    ``<export_base>/<version>/`` (atomic ``publish_export``, so the
+    aggregation tier's scanner never sees a torn dir).  The StableHLO
+    program depends only on the model function and signature — not the
+    weight values — so it is traced/serialized ONCE on the first export
+    and its bytes reused for every later version: the steady-state cost
+    of an export is one host weight gather + one npz write, not a
+    re-trace + XLA lowering per cadence.  A parameter-tree change
+    (different flat names/shapes — a new job on a reused exporter)
+    invalidates the cache and re-traces.
+    """
+
+    def __init__(self, export_base, model_name="",
+                 platforms=("cpu", "tpu"), quantize=None, keep=16):
+        """``keep``: source-base retention — after each export, only
+        the newest ``keep`` versions remain (0 = keep everything).
+        Continuous export mints versions indefinitely; the consumer
+        (the aggregation tier) ingests promptly and tolerates GC'd
+        versions, so a bounded source base trades completeness for
+        not filling the trainer's disk.  Keep it comfortably above
+        the aggregator's window."""
+        self.export_base = export_base
+        self.model_name = model_name
+        self.platforms = tuple(platforms)
+        self.quantize = quantize
+        self.keep = int(keep)
+        self._program = None        # cached model.stablehlo bytes
+        self._manifest = None       # manifest template (dict)
+        self._tree_key = None       # {name: (shape, dtype)} cache key
+        self.exports = 0
+
+    def _key(self, flat):
+        return {n: (tuple(np.shape(v)), str(np.asarray(v).dtype))
+                for n, v in flat.items()}
+
+    def export(self, version, apply_fn, params, example_input,
+               embeddings=None):
+        """Write ``<export_base>/<version>/``; returns the manifest."""
+        version = int(version)
+        export_dir = os.path.join(self.export_base, str(version))
+        if os.path.isfile(os.path.join(export_dir, "manifest.json")):
+            # A complete version is immutable: a restarted worker
+            # re-exporting the version it already wrote must not
+            # swap-rewrite it (the swap path is not single-rename
+            # atomic — see publish_export).
+            logger.info("continuous export: version %d already "
+                        "complete, skipped", version)
+            with open(os.path.join(export_dir, "manifest.json")) as f:
+                return json.load(f)
+        params = to_numpy(params)
+        flat, _ = flatten_with_names(params)
+        key = self._key(flat)
+        if self._program is None or key != self._tree_key:
+            manifest = export_servable(
+                export_dir, apply_fn, params, example_input,
+                model_name=self.model_name, version=version,
+                embeddings=embeddings, platforms=self.platforms,
+                quantize=self.quantize,
+            )
+            with open(os.path.join(export_dir, "model.stablehlo"),
+                      "rb") as f:
+                self._program = f.read()
+            self._manifest = dict(manifest)
+            self._tree_key = key
+        else:
+            quantized = []
+            if self.quantize == "int8":
+                payload, quantized = _quantize_int8(flat)
+            else:
+                payload = dict(flat)
+            # The SAME embedding encoder as the full export, and the
+            # manifest's format/quantized fields recomputed from what
+            # was actually written — a cached template must never
+            # describe encodings this payload does not carry.
+            table_names, emb_quantized = _encode_embeddings(
+                payload, embeddings, self.quantize)
+            fmt = self._manifest["format"].split("+")[-1]
+            if quantized:
+                fmt = "int8-weights+" + fmt
+            if emb_quantized:
+                fmt = "int8-emb+" + fmt
+            manifest = dict(
+                self._manifest, version=version, format=fmt,
+                quantized_int8=sorted(quantized + emb_quantized),
+                embedding_tables=sorted(table_names),
+            )
+            publish_export(export_dir, {
+                "model.npz": _npz_bytes(payload),
+                "model.stablehlo": self._program,
+                "manifest.json": json.dumps(manifest,
+                                            indent=2).encode(),
+            })
+            logger.info("continuous export: version %d at %s "
+                        "(program reused)", version, export_dir)
+        self.exports += 1
+        self._gc()
+        return manifest
+
+    def _gc(self):
+        """Source-base retention: continuous export mints versions
+        forever; keep only the newest ``keep`` (plus reap any staging
+        leftovers — this exporter owns the base)."""
+        if not self.keep:
+            return
+        from elasticdl_tpu.serving.loader import list_versions
+
+        versions = list_versions(self.export_base, gc_incomplete=True)
+        for version in versions[:-self.keep]:
+            shutil.rmtree(
+                os.path.join(self.export_base, str(version)),
+                ignore_errors=True)

@@ -10,27 +10,47 @@ portable artifact is StableHLO + npz instead of GraphDef + variables.
 
 import json
 import os
+import shutil
 
 import numpy as np
 
 
-def list_versions(path):
+def list_versions(path, gc_incomplete=False):
     """COMPLETE numeric versions under a TF-Serving-style base
-    (``path/<N>/`` with a manifest.json — the exporter writes the
-    manifest last, so its presence marks a finished export), sorted
-    ascending.  Empty when ``path`` is a direct export dir or holds no
-    complete version."""
+    (``path/<N>/`` with a manifest.json — the exporter publishes a
+    version dir atomically via tmp-dir + rename, so the manifest's
+    presence marks a finished export), sorted ascending.  Empty when
+    ``path`` is a direct export dir or holds no complete version.
+
+    Incomplete dirs are always SKIPPED; with ``gc_incomplete`` they are
+    also REMOVED: ``*.tmp-*`` staging leftovers (a writer crashed
+    mid-publish — the rename never happened, so nothing references
+    them) and numeric dirs without a manifest (torn exports from a
+    pre-atomic writer; the atomic publisher cannot produce them).
+    ``*.old-*`` dirs are NEVER reaped here: after a crash mid-swap the
+    old dir can be the only complete copy of that export, so it is
+    left for the operator.  Only owners of the export base (the
+    continuous publisher, the aggregation tier) pass
+    ``gc_incomplete`` — a plain reader must not reap another writer's
+    in-flight staging dir."""
     if os.path.isfile(os.path.join(path, "manifest.json")):
         return []
     try:
         entries = os.listdir(path)
     except OSError:
         entries = []
-    return sorted(
-        int(entry) for entry in entries
-        if entry.isdigit() and os.path.isfile(
-            os.path.join(path, entry, "manifest.json"))
-    )
+    complete = []
+    for entry in entries:
+        sub = os.path.join(path, entry)
+        if entry.isdigit():
+            if os.path.isfile(os.path.join(sub, "manifest.json")):
+                complete.append(int(entry))
+            elif gc_incomplete and os.path.isdir(sub):
+                shutil.rmtree(sub, ignore_errors=True)
+        elif gc_incomplete and ".tmp-" in entry \
+                and os.path.isdir(sub):
+            shutil.rmtree(sub, ignore_errors=True)
+    return sorted(complete)
 
 
 def resolve_export_dir(path, version=None):

@@ -42,6 +42,8 @@ Run:
 
 import http.client
 import json
+import math
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -50,6 +52,7 @@ from elasticdl_tpu.serving.fleet import (
     FleetCoordinator,
     FleetState,
     HealthProber,
+    canary_slice,
     pick_replica,
     rendezvous_rank,
 )
@@ -180,7 +183,7 @@ class Router:
     def __init__(self, replica_addrs, export_dir="",
                  probe_interval=0.5, probe_timeout=2.0,
                  request_timeout=60.0, barrier_timeout=120.0,
-                 poll_interval=2.0):
+                 poll_interval=2.0, auto_rollout=True):
         self.state = FleetState(replica_addrs,
                                 probe_interval=probe_interval)
         self.gate = AdmissionGate()
@@ -192,14 +195,43 @@ class Router:
             barrier_timeout=barrier_timeout)
         self.poll_interval = poll_interval
         self.request_timeout = request_timeout
+        # How long a forward waits out an EMPTY routable set before
+        # 503ing: rides probe-timeout ejection blips and whole-fleet
+        # flip instants (>= one probe interval, so a healthy replica's
+        # next probe readmits it inside the grace).
+        self.no_replica_grace = max(2 * probe_interval, 1.0)
         # Routing-only mode (no export base to scan): there is no
         # committed version to pin routing to — any healthy replica is
         # routable, whatever it serves.  With coordination ON, routing
         # is version-pinned to the coordinator's committed version.
         self.coordinating = bool(export_dir)
+        # auto_rollout=False: the scan loop only seeds + heals; every
+        # rollout arrives via /fleet/rollout (the aggregation tier is
+        # the one rollout minter — docs/serving.md "The online loop").
+        self.auto_rollout = bool(auto_rollout)
         self._pools = {addr: _ConnPool(addr, request_timeout)
                        for addr in replica_addrs}
         self._stop = threading.Event()
+        # Control commands (external rollout, canary start / promote /
+        # rollback) execute ON the rollout thread via this queue: one
+        # thread owns every coordinator interaction, so commands
+        # serialize with scan ticks without any lock being held across
+        # the barrier's blocking HTTP/sleep work.
+        self._commands = queue.Queue()
+        # Canary state, written ONLY by the rollout thread, read
+        # per-request as one GIL-atomic tuple:
+        # (version, fraction, frozenset(addrs)) or None.
+        self._canary = None
+        # Per-cohort series for /metrics: requests, keyed share,
+        # errors, latency — many request threads bump, so guarded.
+        self._cohort_lock = threading.Lock()
+        self._cohorts = {c: {"requests": 0, "keyed_requests": 0,
+                             "errors": 0, "latency_ms_sum": 0.0,
+                             "model_version": 0}
+                         for c in ("baseline", "canary")}
+        # Last aggregation-tier report (freshness SLO telemetry),
+        # attached by /fleet/rollout / /fleet/canary posts.
+        self._agg = None
         self._rollout_thread = threading.Thread(
             target=self._rollout_loop, daemon=True,
             name="fleet-rollout")
@@ -231,12 +263,264 @@ class Router:
     def _rollout_loop(self):
         while not self._stop.is_set():
             try:
-                self.coordinator.tick()
+                cmd = self._commands.get(timeout=self.poll_interval)
+            except queue.Empty:
+                cmd = None
+            if self._stop.is_set():
+                if cmd is not None:
+                    cmd[3]["result"] = {"error": "router stopping"}
+                    cmd[2].set()
+                break
+            try:
+                if cmd is not None:
+                    self._handle_command(cmd)
+                else:
+                    # A live canary suspends the version scan: exactly
+                    # one rollout authority at a time (seed/heal keep
+                    # running either way).
+                    self.coordinator.tick(
+                        scan=self.auto_rollout and self._canary is None)
             except Exception as e:  # noqa: BLE001 — a failed scan or
                 # rollout attempt must not kill the coordinator; the
                 # next tick retries
                 logger.warning("fleet tick failed: %s", e)
-            self._stop.wait(self.poll_interval)
+
+    # -- external fleet control (the aggregation tier's surface) -------
+
+    def _command(self, op, payload, timeout=600.0):
+        """Run one control command ON the rollout thread; block the
+        caller (an HTTP handler thread or the aggregation tier in
+        process) until it completed.  Fails fast when there IS no
+        rollout thread (routing-only mode never starts one — a queued
+        command would otherwise wait out the full timeout unserved)."""
+        if not self._rollout_thread.is_alive():
+            return {"error": "router has no rollout coordination "
+                             "(routing-only mode; start with "
+                             "--export_dir)"}
+        done = threading.Event()
+        box = {}
+        self._commands.put((op, payload, done, box))
+        if not done.wait(timeout):
+            return {"error": "timed out waiting for %s" % op}
+        return box.get("result", {"error": "no result"})
+
+    def _handle_command(self, cmd):
+        op, payload, done, box = cmd
+        try:
+            handler = {
+                "rollout": self._cmd_rollout,
+                "canary_start": self._cmd_canary_start,
+                "canary_promote": self._cmd_canary_promote,
+                "canary_rollback": self._cmd_canary_rollback,
+            }[op]
+            box["result"] = handler(payload)
+        except Exception as e:  # noqa: BLE001 — the caller gets the
+            # failure as data; the loop survives
+            logger.warning("fleet command %s failed: %s", op, e)
+            box["result"] = {"error": "%s: %s" % (type(e).__name__, e)}
+        finally:
+            done.set()
+
+    def _note_agg(self, payload):
+        freshness = payload.get("freshness_seconds")
+        if freshness is not None:
+            self._agg = {"freshness_seconds": float(freshness),
+                         "version": int(payload.get("version", 0)),
+                         "at": time.time()}
+
+    def _cmd_rollout(self, payload):
+        """POST /fleet/rollout — one published version through the
+        full prepare→warm→barrier→commit protocol."""
+        version = int(payload["version"])
+        self._note_agg(payload)
+        if self._canary is not None:
+            return {"committed": False,
+                    "error": "canary active (version %d); promote or "
+                             "roll back first" % self._canary[0],
+                    "committed_version":
+                        self.coordinator.committed_version}
+        if not self.coordinator.seeded:
+            self.coordinator.seed_committed()
+        committed = self.coordinator.committed_version
+        if version <= committed:
+            return {"committed": version == committed,
+                    "error": None if version == committed else
+                    "version %d behind committed %d" % (version,
+                                                        committed),
+                    "committed_version": committed}
+        ok = self.coordinator.rollout(version)
+        return {"committed": bool(ok),
+                "committed_version": self.coordinator.committed_version}
+
+    def _cmd_canary_start(self, payload):
+        """POST /fleet/canary — slice ``fraction`` of the key ring
+        onto canary replicas serving ``version``: pick ceil(p*N)
+        healthy replicas (always leaving >= 1 baseline), push them to
+        the canary version (per-replica prepare→warm→commit, no gate —
+        they are unroutable for baseline traffic the moment their
+        version diverges), then publish the canary tuple to routing."""
+        version = int(payload["version"])
+        fraction = float(payload.get("fraction", 0.1))
+        self._note_agg(payload)
+        if not self.coordinating:
+            # Routing-only mode has no committed version: promote has
+            # nothing to barrier against and rollback would push the
+            # canary replicas toward version 0 — both undefined.
+            return {"started": False,
+                    "error": "canary needs rollout coordination "
+                             "(--export_dir)"}
+        if not 0.0 < fraction < 1.0:
+            return {"started": False,
+                    "error": "fraction must be in (0, 1)"}
+        if self._canary is not None:
+            return {"started": False,
+                    "error": "canary already active (version %d)"
+                             % self._canary[0]}
+        committed = self.coordinator.committed_version
+        if version <= committed:
+            return {"started": False,
+                    "error": "version %d not ahead of committed %d"
+                             % (version, committed)}
+        routable = sorted(self.state.routable(
+            committed if self.coordinating else None))
+        want = min(len(routable) - 1,
+                   max(1, math.ceil(fraction * len(routable))))
+        if want < 1:
+            return {"started": False,
+                    "error": "need >= 2 routable replicas for a "
+                             "canary (have %d)" % len(routable)}
+        chosen = payload.get("replicas")
+        if chosen:
+            # Operator-supplied list rides the same safety rails as
+            # the automatic pick: members of the routable set only,
+            # and at least one baseline replica must remain or every
+            # non-canary request 503s for the whole soak.
+            unknown = sorted(set(chosen) - set(routable))
+            if unknown:
+                return {"started": False,
+                        "error": "replicas %s are not routable"
+                                 % unknown}
+            if len(set(chosen)) >= len(routable):
+                return {"started": False,
+                        "error": "canary must leave >= 1 baseline "
+                                 "replica"}
+        else:
+            chosen = routable[-want:]
+        with tracing.span("router.canary", action="start",
+                          version=version, fraction=fraction,
+                          replicas=len(chosen)):
+            pushed = [addr for addr in chosen
+                      if self.coordinator.push_version(addr, version)]
+            if not pushed:
+                return {"started": False,
+                        "error": "no replica accepted canary version "
+                                 "%d" % version}
+            self._canary = (version, fraction, frozenset(pushed))
+        self.state.bump("router.canary_started")
+        logger.info("canary started: version %d on %s (%.0f%% of the "
+                    "key ring)", version, sorted(pushed),
+                    100 * fraction)
+        return {"started": True, "version": version,
+                "fraction": fraction, "replicas": sorted(pushed)}
+
+    def _cmd_canary_promote(self, _payload):
+        """POST /fleet/canary/promote — the canary version goes
+        fleet-wide through the normal barrier (canary replicas are
+        already warm at it; their commit is idempotent), then the
+        canary slice dissolves: baseline keys flip atomically behind
+        the gate, canary keys keep the version they already saw."""
+        canary = self._canary
+        if canary is None:
+            return {"promoted": False, "error": "no canary active"}
+        version = canary[0]
+        with tracing.span("router.canary", action="promote",
+                          version=version):
+            ok = self.coordinator.rollout(version)
+            if ok:
+                self._canary = None
+                self.state.bump("router.canary_promoted")
+        logger.info("canary promote of %d: %s", version,
+                    "ok" if ok else "FAILED (canary still active)")
+        return {"promoted": bool(ok),
+                "committed_version": self.coordinator.committed_version}
+
+    def _cmd_canary_rollback(self, _payload):
+        """POST /fleet/canary/rollback — push every canary replica
+        back DOWN to the committed version (the one deliberate
+        regression path; replica-side refusal is waived via the
+        rollback flag) and dissolve the slice.  Canary-slice keys
+        return to the baseline version: a rollback is exactly the
+        judgment that the canary version must stop serving, so their
+        version regression is the point, not an accident."""
+        canary = self._canary
+        if canary is None:
+            return {"rolled_back": False, "error": "no canary active"}
+        version, _fraction, addrs = canary
+        committed = self.coordinator.committed_version
+        with tracing.span("router.canary", action="rollback",
+                          version=version, to=committed):
+            healed = [addr for addr in sorted(addrs)
+                      if self.coordinator.push_version(
+                          addr, committed, rollback=True)]
+            # The slice dissolves either way: a replica that refused
+            # the downgrade (or died) is simply not routable until the
+            # prober/healer sort it out — it must not keep owning p%
+            # of the key ring.
+            self._canary = None
+            self.state.bump("router.canary_rolled_back")
+        logger.info("canary rollback of %d -> %d: healed %s", version,
+                    committed, healed)
+        return {"rolled_back": True, "healed": healed,
+                "committed_version": committed}
+
+    def external_rollout(self, version, freshness_seconds=None,
+                         timeout=600.0):
+        """In-process form of POST /fleet/rollout (the bench and an
+        embedded aggregation tier call this directly)."""
+        return self._command(
+            "rollout", {"version": version,
+                        "freshness_seconds": freshness_seconds},
+            timeout)
+
+    def start_canary(self, version, fraction, replicas=None,
+                     freshness_seconds=None, timeout=600.0):
+        return self._command(
+            "canary_start",
+            {"version": version, "fraction": fraction,
+             "replicas": replicas,
+             "freshness_seconds": freshness_seconds}, timeout)
+
+    def promote_canary(self, timeout=600.0):
+        return self._command("canary_promote", {}, timeout)
+
+    def rollback_canary(self, timeout=600.0):
+        return self._command("canary_rollback", {}, timeout)
+
+    def canary_view(self):
+        """(version, fraction, frozenset(addrs)) or None — ONE atomic
+        read, the routing hot path's view."""
+        return self._canary
+
+    def canary_addrs(self):
+        canary = self._canary
+        return canary[2] if canary is not None else frozenset()
+
+    # -- elastic membership (the autoscaler's surface) -----------------
+
+    def add_replica(self, addr):
+        """Admit a replica the autoscaler just spawned: pooled + in
+        the table (unroutable until its first successful probe)."""
+        self._pools.setdefault(addr,
+                               _ConnPool(addr, self.request_timeout))
+        self.state.add_replica(addr)
+
+    def remove_replica(self, addr):
+        """Retire a drained replica (autoscaler scale-down: no
+        in-flight forwards reference it — the autoscaler waited)."""
+        self.state.remove_replica(addr)
+        pool = self._pools.pop(addr, None)
+        if pool is not None:
+            pool.clear()
 
     # -- routing -------------------------------------------------------
 
@@ -262,26 +546,128 @@ class Router:
         the replica and retries on a survivor exactly once.  Replica
         selection (``FleetState.acquire``) counts the forward in-flight
         atomically with the pick, so concurrent keyless requests
-        spread instead of herding onto one momentarily-idle replica."""
+        spread instead of herding onto one momentarily-idle replica.
+
+        With a canary active, keyed requests whose key falls on the
+        canary slice of the ring (``canary_slice(key) < p``) route
+        ONLY among the canary replicas (pinned at the canary version);
+        everything else — baseline keys and keyless traffic — routes
+        only among the rest.  Cohorts are disjoint by key, so any one
+        key's ``model_version`` stays monotone through start → soak →
+        promote.  Per-cohort request/error/latency series feed
+        /metrics (the promote-or-rollback evidence)."""
+        canary = self._canary
+        cohort = "baseline"
+        # The baseline pin is a CALLABLE re-read on every acquire
+        # attempt: a request straddling a fleet version flip must pick
+        # up the new committed version on its next try, not spin out
+        # its grace against a version no replica serves anymore.  The
+        # canary pin stays fixed — that pool is defined by its version.
+        version_pin = self.committed_view
+        members, exclude_members = None, ()
+        if canary is not None:
+            version, fraction, addrs = canary
+            if key is not None and canary_slice(key) < fraction:
+                cohort = "canary"
+                version_pin = lambda: version  # noqa: E731
+                members = addrs
+            else:
+                exclude_members = addrs
+        start = time.monotonic()
+        status, body, content_type, addr = self._forward_pool(
+            method, path, raw_body, key, version_pin,
+            members=members, exclude_members=exclude_members)
+        if cohort == "canary" and addr is None:
+            # The whole canary pool died mid-canary: fall back to
+            # baseline (the key regresses to the committed version —
+            # availability beats the canary experiment) and say so.
+            # The request is then BASELINE evidence: counting it under
+            # the canary cohort would let a dead canary pool promote
+            # on requests the canary version never served.
+            self.state.bump("router.canary_fallback")
+            cohort = "baseline"
+            version_pin = self.committed_view
+            status, body, content_type, addr = self._forward_pool(
+                method, path, raw_body, key, self.committed_view,
+                exclude_members=addrs)
+        self._note_cohort(
+            cohort, keyed=key is not None,
+            latency_ms=1e3 * (time.monotonic() - start),
+            error=status >= 500,
+            version=version_pin())
+        return status, body, content_type, addr
+
+    def _note_cohort(self, cohort, keyed, latency_ms, error, version):
+        with self._cohort_lock:
+            c = self._cohorts[cohort]
+            c["requests"] += 1
+            if keyed:
+                c["keyed_requests"] += 1
+            if error:
+                c["errors"] += 1
+            c["latency_ms_sum"] += latency_ms
+            if version:
+                c["model_version"] = int(version)
+
+    def cohort_stats(self):
+        with self._cohort_lock:
+            return {name: dict(c)
+                    for name, c in self._cohorts.items()}
+
+    def _forward_pool(self, method, path, raw_body, key, version_pin,
+                      members=None, exclude_members=()):
+        """``version_pin`` is a CALLABLE evaluated per attempt (see
+        forward(): the baseline pin must track a mid-request fleet
+        flip)."""
         attempts = 0
         exclude = []
+        empty_deadline = None
         while True:
-            committed = self.committed_view()
-            addr = self.state.acquire(committed, key=key,
-                                      exclude=exclude)
+            pinned = version_pin()
+            addr = self.state.acquire(pinned, key=key,
+                                      exclude=exclude,
+                                      members=members,
+                                      exclude_members=exclude_members)
             if addr is None:
+                # An empty routable set is usually a BLIP — a probe
+                # timed out under load and ejected the only replica,
+                # or every replica is mid-flip — so ride it briefly
+                # (the next successful probe readmits within the
+                # probe interval) instead of bouncing the client.
+                now = time.monotonic()
+                if empty_deadline is None:
+                    empty_deadline = now + self.no_replica_grace
+                    self.state.bump("router.no_replica_waits")
+                if now < empty_deadline:
+                    time.sleep(0.02)
+                    continue
                 self.state.bump("router.no_replica")
                 return 503, json.dumps(
                     {"error": "no routable replica (healthy%s)"
-                              % ("" if committed is None else
-                                 " and at committed version %d"
-                                 % committed)}
+                              % ("" if pinned is None else
+                                 " and at version %d" % pinned)}
                 ).encode(), "application/json", None
             try:
-                return self._forward_to(addr, method, path, raw_body)
+                result = self._forward_to(addr, method, path,
+                                          raw_body)
+                if (result[0] == 503 and attempts == 0
+                        and b'"draining"' in result[1]):
+                    # The replica refused ADMISSION (SIGTERM drain) —
+                    # nothing executed, so failing over is replay-safe,
+                    # unlike other 5xx.  Mark it draining now instead
+                    # of waiting out a probe interval: a scale-down
+                    # drops zero requests.
+                    self.state.note_draining(addr)
+                    self.state.bump("router.drain_refusal_retried")
+                    attempts += 1
+                    exclude.append(addr)
+                    continue
+                return result
             except _FORWARD_ERRORS as e:
                 self.state.note_forward_failure(addr, time.monotonic())
-                self._pools[addr].clear()
+                pool = self._pools.get(addr)
+                if pool is not None:
+                    pool.clear()
                 attempts += 1
                 exclude.append(addr)
                 if attempts > 1:
@@ -297,7 +683,11 @@ class Router:
                 self.state.forward_finished(addr)
 
     def _forward_to(self, addr, method, path, raw_body):
-        pool = self._pools[addr]
+        pool = self._pools.get(addr)
+        if pool is None:
+            # Raced a scale-down removal between acquire and here: a
+            # transient pool still forwards this one request cleanly.
+            pool = _ConnPool(addr, self.request_timeout, max_idle=0)
         conn = pool.acquire()
         reusable = False
         try:
@@ -320,12 +710,22 @@ class Router:
 
     def fleet_status(self):
         replicas, counters = self.state.snapshot()
+        canary = self._canary
         return {
             "committed_version": self.coordinator.committed_version,
             "coordinating": self.coordinating,
+            "auto_rollout": self.auto_rollout,
             "replicas": replicas,
             "counters": counters,
             "gate_open": self.gate.is_open,
+            "canary": {
+                "active": canary is not None,
+                "version": canary[0] if canary else None,
+                "fraction": canary[1] if canary else None,
+                "replicas": sorted(canary[2]) if canary else [],
+                "cohorts": self.cohort_stats(),
+            },
+            "aggregation": self._agg,
         }
 
 
@@ -386,6 +786,17 @@ def build_router_server(router, port=0, host="127.0.0.1",
                     411, {"error": "Content-Length required"})
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length)
+            if self.path.startswith("/fleet/"):
+                # Fleet-control plane (the aggregation tier's surface):
+                # executes on the rollout thread, bypasses the
+                # admission gate — a rollout command must be able to
+                # land WHILE the gate is closed for its own barrier.
+                try:
+                    payload = json.loads(raw or b"{}")
+                    return self._fleet_control(payload)
+                except (KeyError, TypeError, ValueError) as e:
+                    return self._reply_json(
+                        400, {"error": "bad fleet command: %s" % e})
             if not self.path.startswith("/v1/"):
                 return self._reply_json(
                     404, {"error": "unknown path %r" % self.path})
@@ -409,6 +820,27 @@ def build_router_server(router, port=0, host="127.0.0.1",
             finally:
                 router.gate.exit_()
 
+        def _fleet_control(self, payload):
+            if self.path == "/fleet/rollout":
+                return self._reply_json(
+                    200, router.external_rollout(
+                        payload["version"],
+                        payload.get("freshness_seconds")))
+            if self.path == "/fleet/canary":
+                return self._reply_json(
+                    200, router.start_canary(
+                        payload["version"],
+                        payload.get("fraction", 0.1),
+                        replicas=payload.get("replicas"),
+                        freshness_seconds=payload.get(
+                            "freshness_seconds")))
+            if self.path == "/fleet/canary/promote":
+                return self._reply_json(200, router.promote_canary())
+            if self.path == "/fleet/canary/rollback":
+                return self._reply_json(200, router.rollback_canary())
+            return self._reply_json(
+                404, {"error": "unknown path %r" % self.path})
+
     server = ThreadingHTTPServer((host, port), Handler)
     server.router = router
     return server
@@ -430,23 +862,55 @@ def main(argv=None):
         request_timeout=args.request_timeout,
         barrier_timeout=args.barrier_timeout,
         poll_interval=args.poll_interval,
+        auto_rollout=args.auto_rollout,
     )
+    autoscaler = spawner = None
+    if args.autoscale:
+        if not args.export_dir:
+            raise SystemExit("--autoscale needs --export_dir (spawned "
+                             "replicas must load from somewhere)")
+        from elasticdl_tpu.serving.fleet import (
+            FleetAutoscaler,
+            ProcessReplicaSpawner,
+        )
+
+        spawner = ProcessReplicaSpawner(args.export_dir)
+        autoscaler = FleetAutoscaler(
+            router, spawner,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            scale_up_queue_ms=args.scale_up_queue_ms,
+            scale_down_queue_ms=args.scale_down_queue_ms,
+            breach_secs=args.breach_secs,
+            idle_secs=args.idle_secs,
+            cooldown_secs=args.autoscale_cooldown_secs,
+        )
     server = build_router_server(router, port=args.port,
                                  host=args.host)
     router.start()
+    if autoscaler is not None:
+        autoscaler.start()
     logger.info(
         "fleet router on %s:%d over %d replica(s) %s (rollout "
-        "coordination: %s)", args.host, server.server_address[1],
-        len(replicas), replicas,
-        "on, scanning %s" % args.export_dir if args.export_dir
-        else "off")
+        "coordination: %s; autoscale: %s)", args.host,
+        server.server_address[1], len(replicas), replicas,
+        ("on, scanning %s%s" % (args.export_dir,
+                                "" if args.auto_rollout
+                                else " (external rollouts only)"))
+        if args.export_dir else "off",
+        "%d..%d replicas" % (args.min_replicas, args.max_replicas)
+        if autoscaler is not None else "off")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.server_close()
+        if autoscaler is not None:
+            autoscaler.stop()
         router.stop()
+        if spawner is not None:
+            spawner.close()
     return 0
 
 

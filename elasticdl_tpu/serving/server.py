@@ -119,7 +119,7 @@ class ModelEndpoint:
 
     def __init__(self, export_dir, name=None, poll_interval=2.0,
                  batching=None, fleet_managed=False,
-                 embedding_service=None):
+                 embedding_service=None, boot_version=None):
         self.export_dir = export_dir
         self.poll_interval = poll_interval
         # Fleet-managed replicas NEVER self-swap from a local disk scan:
@@ -133,7 +133,13 @@ class ModelEndpoint:
         # service per endpoint — its cache is keyed by THIS model's
         # version, re-keyed on every publish.
         self._embedding_service = embedding_service
-        self.model = load_servable(export_dir)
+        # boot_version pins the INITIAL load (the autoscaler spawns
+        # replicas pinned to the fleet's committed version so a fresh
+        # spawn mid-canary can't boot ahead of the fleet off its own
+        # disk scan); later versions arrive via reload/barrier as ever.
+        self.model = load_servable(
+            export_dir if boot_version is None
+            else resolve_export_dir(export_dir, version=boot_version))
         # Versioned mode iff the base itself is not a direct export —
         # then the loader resolved a numeric subdir we can re-scan.
         self._versioned = not os.path.isfile(
@@ -299,17 +305,26 @@ class ModelEndpoint:
         """Version of the model CURRENTLY serving traffic."""
         return int(self._snapshot()[0].manifest.get("version", 0) or 0)
 
-    def prepare_version(self, version):
+    def prepare_version(self, version, rollback=False):
         """Background-load + warm export version ``version`` without
         publishing it (phase 1 of the fleet barrier): traffic keeps
         hitting the warm serving model while the incoming version
         compiles its pad buckets.  Idempotent; returns the fleet-state
-        dict so the coordinator can poll readiness off the reply."""
+        dict so the coordinator can poll readiness off the reply.
+
+        ``rollback`` (the canary-rollback push): preparing a version
+        BELOW the serving one is normally short-circuited as "already
+        there" — the flag makes it actually load, so the matching
+        ``commit_version(..., rollback=True)`` has a warm model to
+        swap down to."""
         version = int(version)
         start = False
         with self._reload_lock:
+            serving_ok = (self.serving_version() == version
+                          if rollback
+                          else self.serving_version() >= version)
             already = (
-                self.serving_version() >= version
+                serving_ok
                 or (self._prepared is not None
                     and self._prepared[0] == version)
                 or (self._preparing == version
@@ -357,7 +372,7 @@ class ModelEndpoint:
                 self._prepared = (version, fresh, dtypes, plan)
                 self._preparing = None
 
-    def commit_version(self, version):
+    def commit_version(self, version, rollback=False):
         """Phase 2 of the fleet barrier: atomically publish a PREPARED
         version.  Refuses a version below the one already serving — a
         coordinator healing a rejoined replica can therefore never
@@ -365,19 +380,30 @@ class ModelEndpoint:
         coordinator re-prepares and retries).  In-queue requests
         admitted before the flip finish on the model they were
         marshalled against (the batcher's version purity): stale-version
-        traffic drains, it never mixes."""
+        traffic drains, it never mixes.
+
+        ``rollback`` waives the regression refusal for exactly ONE
+        caller: the coordinator's canary rollback, a deliberate
+        operator-path downgrade of a canary replica back to the
+        fleet's committed version (docs/serving.md "The online loop").
+        The plain barrier/heal path never sets it, so a confused
+        coordinator still cannot regress a replica by accident."""
         version = int(version)
         with self._reload_lock:
             serving = self.serving_version()
             if serving == version:
                 return {"committed": True, "serving": serving}
-            if version < serving:
+            if version < serving and not rollback:
                 return {"committed": False, "serving": serving,
                         "error": "version %d would regress serving "
                                  "version %d" % (version, serving)}
             if self._prepared is None or self._prepared[0] != version:
                 return {"committed": False, "serving": serving,
                         "error": "version %d not prepared" % version}
+            if version < serving:
+                logger.warning(
+                    "ROLLBACK commit: model %r serving %d -> %d",
+                    self.name, serving, version)
             _, fresh, dtypes, plan = self._prepared
             self._prepared = None
             with self._lock:
@@ -393,7 +419,7 @@ class ModelEndpoint:
         # POST, so no gRPC propagation — the replica-local instant is
         # still the serving half of the barrier timeline).
         tracing.event("serving.version_commit", model=self.name,
-                      version=version)
+                      version=version, rollback=bool(rollback))
         logger.info("fleet commit: model %r now serving version %d",
                     self.name, version)
         return {"committed": True, "serving": version}
@@ -724,11 +750,15 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
             try:
                 if self.path == "/fleet/prepare":
                     return self._reply(200, {
-                        name: endpoint.prepare_version(body["version"])
+                        name: endpoint.prepare_version(
+                            body["version"],
+                            rollback=bool(body.get("rollback")))
                         for name, endpoint in by_name.items()})
                 if self.path == "/fleet/commit":
                     return self._reply(200, {
-                        name: endpoint.commit_version(body["version"])
+                        name: endpoint.commit_version(
+                            body["version"],
+                            rollback=bool(body.get("rollback")))
                         for name, endpoint in by_name.items()})
                 route = post_routes.get(self.path)
                 if route is None:
@@ -823,6 +853,8 @@ def main(argv=None):
             poll_interval=args.poll_interval, batching=batching,
             fleet_managed=args.fleet_managed,
             embedding_service=service,
+            boot_version=(args.boot_version
+                          if args.boot_version >= 0 else None),
         )
 
     if multi:

@@ -69,6 +69,22 @@ def add_common_args(parser):
                              "staged behind the running step; 0 keeps "
                              "batch prep on the dispatch critical "
                              "path")
+    parser.add_argument("--export_base", default="",
+                        help="versioned servable export base for the "
+                             "online-learning loop: worker 0 writes a "
+                             "complete <base>/<version>/ servable "
+                             "every --export_steps optimizer steps "
+                             "(atomic publish; the aggregation tier "
+                             "ingests from here — docs/serving.md "
+                             "'The online loop'); empty = no "
+                             "continuous export")
+    parser.add_argument("--export_steps", type=int, default=0,
+                        help="continuous-export cadence in optimizer "
+                             "steps (0 = off); worker-0-only, the "
+                             "same guard as checkpointing.  The "
+                             "StableHLO program is traced once and "
+                             "reused, so steady-state export cost is "
+                             "one weight gather + npz write")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--profile_dir", default="",
                         help="write a JAX/XLA xplane trace of the worker "
@@ -315,6 +331,15 @@ def add_serving_args(parser):
                              "admitting (503 + Connection: close), "
                              "lets in-flight batches finish up to this "
                              "long, then exits")
+    parser.add_argument("--boot_version", type=int, default=-1,
+                        help="pin the INITIAL load to one export "
+                             "version instead of the newest complete "
+                             "one on disk; the fleet autoscaler "
+                             "launches replicas pinned to the "
+                             "committed version so a fresh spawn "
+                             "mid-canary cannot race ahead of the "
+                             "fleet off its own disk scan (-1 = "
+                             "newest)")
 
 
 def build_serving_parser():
@@ -357,11 +382,111 @@ def add_router_args(parser):
                              "replica to pre-warm an incoming version "
                              "before the rollout attempt is abandoned "
                              "and retried on the next scan")
+    parser.add_argument("--auto_rollout", type=_str2bool, default=True,
+                        help="false: the export-dir scan only SEEDS "
+                             "the committed version and heals lagging "
+                             "rejoiners — rollouts arrive exclusively "
+                             "through POST /fleet/rollout (the "
+                             "aggregation tier is the one rollout "
+                             "minter; docs/serving.md 'The online "
+                             "loop')")
+    # Autoscaler (serving/fleet.py FleetAutoscaler): spawn/drain
+    # serving replicas off the router's OWN telemetry.
+    parser.add_argument("--autoscale", type=_str2bool, default=False,
+                        help="grow/shrink the replica set off router "
+                             "telemetry: sustained queue-wait breach "
+                             "spawns a replica (up to --max_replicas), "
+                             "sustained idle drains one via the "
+                             "SIGTERM graceful-drain path (down to "
+                             "--min_replicas); spawned replicas boot "
+                             "pinned to the committed version")
+    parser.add_argument("--min_replicas", type=int, default=1)
+    parser.add_argument("--max_replicas", type=int, default=4)
+    parser.add_argument("--scale_up_queue_ms", type=float, default=25.0,
+                        help="mean probed queue-wait above this for "
+                             "--breach_secs = scale up")
+    parser.add_argument("--scale_down_queue_ms", type=float,
+                        default=2.0,
+                        help="queue-wait below this (and no in-flight "
+                             "backlog) for --idle_secs = scale down")
+    parser.add_argument("--breach_secs", type=float, default=3.0)
+    parser.add_argument("--idle_secs", type=float, default=10.0)
+    parser.add_argument("--autoscale_cooldown_secs", type=float,
+                        default=5.0,
+                        help="minimum seconds between scaling moves "
+                             "(lets the previous move's effect reach "
+                             "the telemetry before the next decision)")
 
 
 def build_router_parser():
     parser = argparse.ArgumentParser("elasticdl_tpu.serving.router")
     add_router_args(parser)
+    return parser
+
+
+def build_aggregator_parser():
+    """Aggregation-tier flags (aggregation/main.py): the daemon
+    between trainer exports and the serving fleet (docs/serving.md
+    'The online loop')."""
+    parser = argparse.ArgumentParser("elasticdl_tpu.aggregation")
+    parser.add_argument("--source_dir", required=True,
+                        help="trainer continuous-export base "
+                             "(--export_base on the worker): scanned "
+                             "for new complete versions every "
+                             "--poll_interval")
+    parser.add_argument("--publish_dir", required=True,
+                        help="fleet export base: aggregated servable "
+                             "versions are published here atomically "
+                             "and rolled out through the router")
+    parser.add_argument("--model_name", default="")
+    parser.add_argument("--window", type=int, default=4,
+                        help="aggregate over the last W ingested "
+                             "exports (version-deduped)")
+    parser.add_argument("--agg_mode", default="ema",
+                        choices=["ema", "mean", "latest"],
+                        help="ema: decay-weighted toward the newest "
+                             "export; mean: uniform; latest: no "
+                             "aggregation (pass-through)")
+    parser.add_argument("--ema_decay", type=float, default=0.5)
+    parser.add_argument("--freshness_slo_secs", type=float,
+                        default=10.0,
+                        help="publish-freshness objective: seconds "
+                             "between a trainer export landing and "
+                             "its aggregate publishing; breaches are "
+                             "counted (slo_misses) and the live value "
+                             "rides to the router's /metrics as "
+                             "elasticdl_agg_freshness_seconds")
+    parser.add_argument("--publish_interval_secs", type=float,
+                        default=0.0,
+                        help="publish throttle: minimum seconds "
+                             "between publishes (each publish costs "
+                             "the fleet a rollout); 0 = publish on "
+                             "every new ingest")
+    parser.add_argument("--export_keep", type=int, default=8,
+                        help="retention over the publish base: keep "
+                             "the newest N published versions; the "
+                             "fleet's committed version and anything "
+                             "newer are NEVER removed (0 = keep "
+                             "everything)")
+    parser.add_argument("--router_addr", default="",
+                        help="fleet router host:port — each publish "
+                             "is driven through POST /fleet/rollout "
+                             "(or the canary endpoints); empty = "
+                             "publish only, something else rolls out")
+    parser.add_argument("--poll_interval", type=float, default=1.0)
+    parser.add_argument("--canary_fraction", type=float, default=0.0,
+                        help="canary-first rollouts: slice this "
+                             "fraction of the key ring onto canary "
+                             "replicas, soak, then promote "
+                             "barrier-clean or roll back off the "
+                             "router's per-cohort error counters "
+                             "(0 = plain fleet-wide rollouts)")
+    parser.add_argument("--canary_soak_secs", type=float, default=15.0)
+    parser.add_argument("--canary_max_error_ratio", type=float,
+                        default=0.02,
+                        help="canary error budget over the soak "
+                             "window; above it the canary is rolled "
+                             "back instead of promoted")
     return parser
 
 

@@ -236,4 +236,42 @@ def fleet_to_prometheus(status):
     for name, value in sorted(status.get("counters", {}).items()):
         lines.append(prometheus_line("elasticdl_fleet_router_counter",
                                      value, name=name))
+    canary = status.get("canary") or {}
+    lines.append(prometheus_line("elasticdl_fleet_canary_active",
+                                 int(bool(canary.get("active")))))
+    if canary.get("active"):
+        lines.append(prometheus_line("elasticdl_fleet_canary_version",
+                                     canary.get("version", 0)))
+        lines.append(prometheus_line(
+            "elasticdl_fleet_canary_fraction",
+            canary.get("fraction", 0.0)))
+        lines.append(prometheus_line(
+            "elasticdl_fleet_canary_replicas",
+            len(canary.get("replicas", []))))
+    for cohort, c in sorted((canary.get("cohorts") or {}).items()):
+        def gauge(metric, value, _cohort=cohort):
+            lines.append(prometheus_line(metric, value,
+                                         cohort=_cohort))
+
+        gauge("elasticdl_fleet_canary_requests", c.get("requests", 0))
+        gauge("elasticdl_fleet_canary_keyed_requests",
+              c.get("keyed_requests", 0))
+        gauge("elasticdl_fleet_canary_errors", c.get("errors", 0))
+        if c.get("requests"):
+            gauge("elasticdl_fleet_canary_latency_ms",
+                  round(c.get("latency_ms_sum", 0.0)
+                        / c["requests"], 3))
+        gauge("elasticdl_fleet_canary_model_version",
+              c.get("model_version", 0))
+    agg = status.get("aggregation") or {}
+    if agg.get("freshness_seconds") is not None:
+        # The aggregation tier's publish-freshness SLO telemetry
+        # (docs/serving.md "The online loop"): rides in on
+        # /fleet/rollout + /fleet/canary posts so the whole loop
+        # scrapes at ONE point — the router.
+        lines.append(prometheus_line("elasticdl_agg_freshness_seconds",
+                                     round(agg["freshness_seconds"],
+                                           3)))
+        lines.append(prometheus_line(
+            "elasticdl_agg_published_version", agg.get("version", 0)))
     return "\n".join(lines) + "\n"

@@ -123,6 +123,8 @@ class CollectiveTrainer(Trainer):
         checkpoint_steps=0,
         use_bf16_compute=False,
         zero1=False,
+        exporter=None,
+        export_steps=0,
     ):
         self._spec = spec
         self._batch_size = batch_size
@@ -132,6 +134,13 @@ class CollectiveTrainer(Trainer):
         self._report_version_steps = report_version_steps
         self._checkpoint_saver = checkpoint_saver
         self._checkpoint_steps = checkpoint_steps
+        # Continuous servable export (the online-learning loop's trainer
+        # half, docs/serving.md): every export_steps optimizer steps a
+        # complete versioned servable lands at the exporter's base for
+        # the aggregation tier to ingest.  Worker-0-only, same guard as
+        # checkpointing (worker/main zeroes export_steps elsewhere).
+        self._exporter = exporter
+        self._export_steps = export_steps if exporter is not None else 0
         self._use_bf16_compute = use_bf16_compute
         # ZeRO-1: shard optimizer state over the data axis instead of
         # replicating it — Adam moments cost 2x params, so an 8-way dp
@@ -149,6 +158,7 @@ class CollectiveTrainer(Trainer):
         self._version = 0
         self._ckpt_executor = None
         self._ckpt_future = None
+        self._export_future = None
         self._example_features = None
 
         params = spec.init_fn(jax.random.PRNGKey(rng_seed))
@@ -789,6 +799,10 @@ class CollectiveTrainer(Trainer):
                 self._checkpoint_steps
                 - self._version % self._checkpoint_steps
             )
+        if self._export_steps:
+            dists.append(
+                self._export_steps - self._version % self._export_steps
+            )
         return min(dists) if dists else None
 
     def stage_window(self, prepared, to_device=True):
@@ -866,6 +880,8 @@ class CollectiveTrainer(Trainer):
             and self._version % self._checkpoint_steps == 0
         ):
             self.save_checkpoint()
+        if self._export_steps and self._version % self._export_steps == 0:
+            self.export_servable_now()
 
     def _forward_local(self, features):
         """Inference on THIS process only: local device, local copy of
@@ -998,6 +1014,37 @@ class CollectiveTrainer(Trainer):
         logger.info("checkpoint at version %d queued for write",
                     self._version)
 
+    def export_servable_now(self):
+        """Continuous-export hook body (``--export_steps`` cadence):
+        snapshot params on the caller (the next step's buffer donation
+        invalidates device arrays, exactly the checkpoint constraint),
+        then write the versioned servable on the same single background
+        writer thread checkpoints use — the train loop pays host-gather
+        time only, never npz serialization + fsync + rename.  The first
+        export additionally traces/serializes the StableHLO program
+        (ContinuousExporter caches it; steady state is weights-only).
+        Errors surface on the NEXT cadence event, like checkpoint
+        write errors."""
+        bundle = self.serving_bundle()
+        if bundle is None or self._exporter is None:
+            return
+        with self.timing.timeit("servable_export"):
+            infer_fn, params, example = bundle
+            version = self._version
+            if self._ckpt_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._ckpt_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ckpt-write"
+                )
+            self._surface_export_errors(wait=True)
+            tracing.event("worker.servable_export", version=version)
+            self._export_future = self._ckpt_executor.submit(
+                self._exporter.export, version, infer_fn, params,
+                example,
+            )
+        self.timing.bump("servable_exports")
+
     def _surface_checkpoint_errors(self, wait):
         future = getattr(self, "_ckpt_future", None)
         if future is None:
@@ -1011,6 +1058,19 @@ class CollectiveTrainer(Trainer):
                     "async checkpoint write failed: %s" % (e,)
                 ) from e
 
+    def _surface_export_errors(self, wait):
+        future = self._export_future
+        if future is None:
+            return
+        if wait or future.done():
+            self._export_future = None
+            try:
+                future.result()
+            except Exception as e:  # noqa: BLE001 — IO / trace errors
+                raise RuntimeError(
+                    "async servable export failed: %s" % (e,)
+                ) from e
+
     def flush_checkpoints(self):
         """Join pending checkpoint writes AND retire the writer thread
         (train end / before export).  Shutting the executor down here —
@@ -1020,6 +1080,7 @@ class CollectiveTrainer(Trainer):
         The next async save simply recreates it."""
         try:
             self._surface_checkpoint_errors(wait=True)
+            self._surface_export_errors(wait=True)
         finally:
             # Retire the pool even when the surfaced write error
             # raises — the failure path must not leak the thread.

@@ -72,6 +72,19 @@ def _build_collective_trainer(args, mc, spec, worker_id,
             # collective path replicates params, so any single copy is
             # the model).
             checkpoint_steps = 0
+    exporter = None
+    export_steps = getattr(args, "export_steps", 0)
+    if getattr(args, "export_base", "") and export_steps:
+        if worker_id != 0:
+            # Same single-writer guard as checkpointing: params are
+            # replicated, so worker 0's exports ARE the model.
+            export_steps = 0
+        else:
+            from elasticdl_tpu.serving.export import ContinuousExporter
+
+            exporter = ContinuousExporter(
+                args.export_base, model_name=args.job_name
+            )
     trainer = CollectiveTrainer(
         spec,
         batch_size=batch_size,
@@ -84,6 +97,8 @@ def _build_collective_trainer(args, mc, spec, worker_id,
         use_bf16_compute=args.use_bf16,
         rng_seed=seed,
         zero1=args.zero1,
+        exporter=exporter,
+        export_steps=export_steps,
     )
     if saver is not None:
         trainer.init_from_checkpoint()

@@ -1,0 +1,345 @@
+"""The model-aggregation tier (elasticdl_tpu/aggregation/): ingest
+monotonicity, window aggregation math, atomic publish, freshness SLO
+accounting, retention GC floors, the trainer's continuous-export hook,
+and the ContinuousExporter's program reuse."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.aggregation import ModelAggregator
+from elasticdl_tpu.serving.export import ContinuousExporter
+from elasticdl_tpu.serving.loader import (
+    list_versions,
+    load_servable,
+)
+
+
+def _apply(p, x):
+    return x @ p["w"]
+
+
+def _exporter(base):
+    return ContinuousExporter(str(base), model_name="lin",
+                              platforms=("cpu",))
+
+
+def _export(ce, version, value):
+    ce.export(version, _apply,
+              {"w": np.full((4, 2), value, np.float32)},
+              np.zeros((1, 4), np.float32))
+
+
+def _published_value(pub):
+    model = load_servable(str(pub))
+    out = np.asarray(model.predict(np.ones((1, 4), np.float32)))
+    return float(out[0, 0]) / 4.0
+
+
+def test_ingest_is_version_monotone(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    agg = ModelAggregator(str(src), str(pub), window=4)
+    _export(ce, 10, 1.0)
+    _export(ce, 20, 2.0)
+    assert agg.ingest_once() == [10, 20]
+    # A re-formed world's out-of-order export: a SECOND exporter (new
+    # program cache, like a relaunched worker 0) lands version 15.
+    _export(_exporter(src), 15, 9.0)
+    assert agg.ingest_once() == []
+    stats = agg.stats()
+    assert stats["counters"]["stale_exports_skipped"] == 1
+    assert stats["last_ingested_version"] == 20
+    # Counted once, not once per scan.
+    agg.ingest_once()
+    assert agg.stats()["counters"]["stale_exports_skipped"] == 1
+
+
+def test_mean_and_ema_window_math(tmp_path):
+    src = tmp_path / "src"
+    ce = _exporter(src)
+    for version, value in ((1, 1.0), (2, 2.0), (3, 3.0)):
+        _export(ce, version, value)
+
+    mean = ModelAggregator(str(src), str(tmp_path / "mean"),
+                           window=3, mode="mean")
+    mean.ingest_once()
+    mean.publish()
+    assert _published_value(tmp_path / "mean") == pytest.approx(2.0)
+
+    # EMA decay 0.5 over [1, 2, 3]: weights 0.25/0.5/1 normalized ->
+    # (0.25*1 + 0.5*2 + 1*3) / 1.75
+    ema = ModelAggregator(str(src), str(tmp_path / "ema"),
+                          window=3, mode="ema", ema_decay=0.5)
+    ema.ingest_once()
+    ema.publish()
+    assert _published_value(tmp_path / "ema") == pytest.approx(
+        (0.25 * 1 + 0.5 * 2 + 1 * 3) / 1.75)
+
+    latest = ModelAggregator(str(src), str(tmp_path / "latest"),
+                             window=3, mode="latest")
+    latest.ingest_once()
+    latest.publish()
+    assert _published_value(tmp_path / "latest") == pytest.approx(3.0)
+
+
+def test_window_caps_membership(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    for version, value in ((1, 10.0), (2, 1.0), (3, 1.0)):
+        _export(ce, version, value)
+    agg = ModelAggregator(str(src), str(pub), window=2, mode="mean")
+    agg.ingest_once()
+    agg.publish()
+    # Version 1 (value 10) fell off the 2-wide window.
+    assert _published_value(pub) == pytest.approx(1.0)
+
+
+def test_publish_is_atomic_and_carries_aggregation_manifest(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    _export(ce, 1, 1.0)
+    _export(ce, 2, 2.0)
+    agg = ModelAggregator(str(src), str(pub), window=2, mode="mean")
+    agg.ingest_once()
+    version, freshness = agg.publish()
+    assert version == 2 and freshness >= 0.0
+    assert sorted(os.listdir(pub)) == ["2"]  # no staging leftovers
+    with open(pub / "2" / "manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["version"] == 2
+    assert manifest["aggregation"]["mode"] == "mean"
+    assert manifest["aggregation"]["source_versions"] == [1, 2]
+    assert manifest["format"].startswith("elasticdl_tpu_servable")
+
+
+def test_publish_due_throttle_and_slo_miss_counting(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    _export(ce, 1, 1.0)
+    agg = ModelAggregator(str(src), str(pub), window=2,
+                          freshness_slo_secs=0.0,  # every publish late
+                          min_publish_interval_secs=3600.0)
+    agg.ingest_once()
+    assert agg.publish_due()  # first publish never throttled
+    agg.publish()
+    assert agg.stats()["counters"]["slo_misses"] == 1
+    _export(ce, 2, 2.0)
+    agg.ingest_once()
+    # New ingest waiting, but inside the throttle interval.
+    assert not agg.publish_due()
+    assert agg.publish_due(now=agg._last_publish_at + 3601)
+
+
+def test_retention_gc_floors_at_committed(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    agg = ModelAggregator(str(src), str(pub), window=1,
+                          export_keep=2)
+    for version in (1, 2, 3, 4):
+        _export(ce, version, float(version))
+        agg.ingest_once()
+        agg.publish()
+    assert list_versions(str(pub)) == [1, 2, 3, 4]
+    # Unknown committed floor: nothing is removed.
+    assert agg.gc_published(committed_floor=None) == []
+    # Committed = 2: version 2 and newer are protected even though
+    # keep=2 would otherwise allow removing 2.
+    assert agg.gc_published(committed_floor=2) == [1]
+    assert list_versions(str(pub)) == [2, 3, 4]
+    # Committed = 4: keep the newest 2, floor protects nothing extra.
+    assert agg.gc_published(committed_floor=4) == [2]
+    assert list_versions(str(pub)) == [3, 4]
+
+
+def test_broken_export_is_skipped_then_superseded(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    _export(ce, 1, 1.0)
+    # A "complete" version whose payload is unreadable.
+    os.makedirs(src / "2")
+    (src / "2" / "manifest.json").write_text("{}")
+    agg = ModelAggregator(str(src), str(pub), window=4)
+    assert agg.ingest_once() == [1]
+    assert agg.stats()["counters"]["ingest_errors"] == 1
+    # A later good version supersedes it; the broken one becomes a
+    # stale skip, not a permanent retry.
+    _export(ce, 3, 3.0)
+    assert agg.ingest_once() == [3]
+    agg.ingest_once()
+    assert agg.stats()["counters"]["stale_exports_skipped"] == 1
+
+
+def test_continuous_exporter_reuses_program(tmp_path):
+    src = tmp_path / "src"
+    ce = _exporter(src)
+    _export(ce, 1, 1.0)
+    _export(ce, 2, 2.0)
+    with open(src / "1" / "model.stablehlo", "rb") as f:
+        program1 = f.read()
+    with open(src / "2" / "model.stablehlo", "rb") as f:
+        program2 = f.read()
+    assert program1 == program2  # traced once, bytes reused
+    # ...and the reused-program export still predicts correctly.
+    model = load_servable(str(src / "2"))
+    out = np.asarray(model.predict(np.ones((1, 4), np.float32)))
+    assert out[0, 0] == pytest.approx(8.0)
+    # A changed parameter tree re-traces instead of mis-serving.
+    ce.export(3, lambda p, x: x @ p["w2"],
+              {"w2": np.full((4, 3), 1.0, np.float32)},
+              np.zeros((1, 4), np.float32))
+    model3 = load_servable(str(src / "3"))
+    assert np.asarray(
+        model3.predict(np.ones((1, 4), np.float32))).shape == (1, 3)
+
+
+def test_continuous_exporter_source_retention(tmp_path):
+    src = tmp_path / "src"
+    ce = ContinuousExporter(str(src), model_name="lin",
+                            platforms=("cpu",), keep=3)
+    for version in range(1, 7):
+        _export(ce, version, float(version))
+    assert list_versions(str(src)) == [4, 5, 6]
+    unbounded = ContinuousExporter(str(tmp_path / "all"),
+                                   model_name="lin",
+                                   platforms=("cpu",), keep=0)
+    for version in (1, 2):
+        _export(unbounded, version, 1.0)
+    assert list_versions(str(tmp_path / "all")) == [1, 2]
+
+
+def test_continuous_exporter_reuse_path_manifest_is_truthful(
+        tmp_path):
+    """The program-reuse export must write the SAME encodings the full
+    export would — and its manifest must describe this payload, not
+    the cached template's."""
+    src = tmp_path / "src"
+    ce = ContinuousExporter(str(src), model_name="lin",
+                            platforms=("cpu",), quantize="int8")
+    table = (np.arange(256), np.ones((256, 16), np.float32))
+
+    def export_with_table(version):
+        ce.export(version, _apply,
+                  {"w": np.full((4, 2), 1.0, np.float32)},
+                  np.zeros((1, 4), np.float32),
+                  embeddings={"users": table})
+
+    export_with_table(1)
+    export_with_table(2)  # the reuse path
+    for version in (1, 2):
+        with open(src / str(version) / "manifest.json") as f:
+            manifest = json.load(f)
+        with np.load(src / str(version) / "model.npz") as z:
+            keys = set(z.files)
+        assert manifest["format"].startswith("int8-emb+")
+        assert "emb:users" in manifest["quantized_int8"]
+        assert manifest["embedding_tables"] == ["users"]
+        assert "q8emb/users" in keys and "emb_vals/users" not in keys
+    # And the loader round-trips the reused-program export.
+    model = load_servable(str(src / "2"))
+    assert np.allclose(model.lookup_embedding("users", [3]), 1.0,
+                       atol=0.02)
+
+
+def test_trainer_export_hook_cadence(tmp_path):
+    from elasticdl_tpu.models import mnist
+    from elasticdl_tpu.worker.collective_trainer import (
+        CollectiveTrainer,
+    )
+
+    src = tmp_path / "src"
+    spec = mnist.model_spec(learning_rate=1e-3)
+    ce = ContinuousExporter(str(src), model_name="mnist",
+                            platforms=("cpu",))
+    trainer = CollectiveTrainer(spec, batch_size=16, exporter=ce,
+                                export_steps=3)
+    xs, ys = mnist.synthetic_data(n=16)
+    for _ in range(7):
+        trainer.train_minibatch(xs, ys)
+    assert trainer.steps_to_boundary() == 2  # next export at 9
+    trainer.flush_checkpoints()  # joins the async export writes
+    assert list_versions(str(src)) == [3, 6]
+    model = load_servable(str(src))
+    assert model.manifest["version"] == 6
+    assert np.asarray(model.predict(xs[:2])).shape == (2, 10)
+    assert trainer.timing.counters()["servable_exports"] == 2
+
+
+def test_worker_main_guard_is_worker_zero_only(tmp_path):
+    from elasticdl_tpu.models import mnist
+    from elasticdl_tpu.utils.args import parse_worker_args
+    from elasticdl_tpu.worker.main import _build_collective_trainer
+
+    spec = mnist.model_spec(learning_rate=1e-3)
+    args = parse_worker_args([
+        "--export_base", str(tmp_path / "src"),
+        "--export_steps", "4",
+    ])
+    chief = _build_collective_trainer(args, None, spec, worker_id=0)
+    follower = _build_collective_trainer(args, None, spec,
+                                         worker_id=1)
+    assert chief._export_steps == 4
+    assert chief._exporter is not None
+    assert follower._export_steps == 0
+
+
+def test_republish_after_restart_is_an_idempotent_skip(tmp_path):
+    """A restarted aggregator (or worker) replaying its state must not
+    rewrite a complete published version — the swap path is not
+    single-rename atomic, and the fleet may have committed that dir."""
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(src)
+    _export(ce, 5, 2.0)
+    agg = ModelAggregator(str(src), str(pub), window=2, mode="mean")
+    agg.ingest_once()
+    agg.publish()
+    before = (pub / "5" / "model.npz").read_bytes()
+    # Fresh aggregator, same dirs (restart): re-ingests and re-reaches
+    # publish for the same version.
+    agg2 = ModelAggregator(str(src), str(pub), window=2,
+                           mode="latest")
+    agg2.ingest_once()
+    version, _ = agg2.publish()
+    assert version == 5
+    assert agg2.stats()["counters"]["republish_skipped"] == 1
+    assert (pub / "5" / "model.npz").read_bytes() == before
+    # Same rule on the trainer side: a relaunched worker re-exporting
+    # its last version leaves the complete dir untouched.
+    ce2 = _exporter(src)
+    manifest = ce2.export(5, _apply,
+                          {"w": np.full((4, 2), 99.0, np.float32)},
+                          np.zeros((1, 4), np.float32))
+    assert manifest["version"] == 5
+    assert _published_value(src / "5") == pytest.approx(2.0)
+
+
+def test_program_cache_keyed_on_shapes_not_names(tmp_path):
+    """A resized layer keeps its flat name; the aggregator must
+    publish the re-traced program its export carries, not the cached
+    one for the old shape."""
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    agg = ModelAggregator(str(src), str(pub), window=1,
+                          mode="latest")
+    ce = _exporter(src)
+    _export(ce, 1, 1.0)
+    agg.ingest_once()
+    agg.publish()
+    # Same flat name "w", NEW shape (4, 3): a fresh exporter re-traces.
+    ContinuousExporter(str(src), model_name="lin",
+                       platforms=("cpu",)).export(
+        2, _apply, {"w": np.full((4, 3), 1.0, np.float32)},
+        np.zeros((1, 4), np.float32))
+    agg.ingest_once()
+    agg.publish()
+    model = load_servable(str(pub / "2"))
+    out = np.asarray(model.predict(np.ones((1, 4), np.float32)))
+    assert out.shape == (1, 3)  # the new-shape program, not the stale one
+
+
+def test_bad_mode_and_decay_refused(tmp_path):
+    with pytest.raises(ValueError, match="mode"):
+        ModelAggregator(str(tmp_path), str(tmp_path), mode="median")
+    with pytest.raises(ValueError, match="ema_decay"):
+        ModelAggregator(str(tmp_path), str(tmp_path), ema_decay=1.5)

@@ -1,0 +1,670 @@
+"""The fleet's online-loop surface (serving/router.py +
+serving/fleet.py): external rollouts, canary keyspace slicing with
+cohort isolation / promote / rollback, the autoscaler policy, and the
+canary + aggregation /metrics series."""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.serving.export import export_servable
+from elasticdl_tpu.serving.fleet import (
+    FleetAutoscaler,
+    FleetState,
+    canary_slice,
+)
+from elasticdl_tpu.serving.router import Router, build_router_server
+from elasticdl_tpu.serving.server import ModelEndpoint, build_server
+from elasticdl_tpu.utils.prom import fleet_to_prometheus
+
+W = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+
+def _export_version(base, version, bias=0.0):
+    export_servable(
+        os.path.join(str(base), str(version)),
+        lambda p, x: x @ p["w"] + bias, {"w": W},
+        np.zeros((1, 4), np.float32), model_name="lin",
+        version=version, platforms=("cpu",),
+    )
+
+
+class _Replica:
+    def __init__(self, base, **kwargs):
+        kwargs.setdefault("fleet_managed", True)
+        self.endpoint = ModelEndpoint(str(base), **kwargs)
+        self.server = build_server(self.endpoint, port=0)
+        self.addr = "127.0.0.1:%d" % self.server.server_address[1]
+        self._dead = False
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def kill(self):
+        """Close the listening socket — the observable signature of a
+        dead replica process."""
+        if not self._dead:
+            self._dead = True
+            self.server.shutdown()
+            self.server.server_close()
+
+    def close(self):
+        self.kill()
+        self.endpoint.close()
+
+
+def _wait(predicate, timeout=20, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    """3 in-process replicas behind an externally-driven router."""
+    base = tmp_path / "exports"
+    _export_version(base, 1)
+    replicas = [_Replica(base) for _ in range(3)]
+    router = Router([r.addr for r in replicas], export_dir=str(base),
+                    probe_interval=0.05, poll_interval=0.1,
+                    auto_rollout=False)
+    server = build_router_server(router, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    router.start(coordinate=True)
+    assert _wait(lambda: router.coordinator.committed_version == 1
+                 and len(router.state.routable(1)) == 3), (
+        router.fleet_status())
+    yield {"router": router, "port": port, "base": base,
+           "replicas": replicas}
+    router.stop()
+    server.shutdown()
+    server.server_close()
+    for replica in replicas:
+        replica.close()
+
+
+def _post(port, path, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        conn.request("POST", path, body=json.dumps(payload))
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _predict(port, key):
+    status, out = _post(port, "/v1/models/lin:predict",
+                        {"instances": [[1, 1, 1, 1]],
+                         "routing_key": key})
+    return status, out.get("model_version")
+
+
+def _keys(n=200):
+    return ["user-%d" % i for i in range(n)]
+
+
+# -- external rollout --------------------------------------------------
+
+
+def test_external_rollout_and_auto_rollout_off(fleet):
+    router, port, base = (fleet["router"], fleet["port"],
+                          fleet["base"])
+    _export_version(base, 2, bias=1.0)
+    # auto_rollout=False: the scan loop must NOT pick it up itself.
+    time.sleep(0.5)
+    assert router.coordinator.committed_version == 1
+    status, out = _post(port, "/fleet/rollout",
+                        {"version": 2, "freshness_seconds": 1.5})
+    assert status == 200 and out["committed"]
+    assert out["committed_version"] == 2
+    assert _wait(lambda: len(router.state.routable(2)) == 3)
+    # Freshness telemetry landed on the fleet status + /metrics.
+    assert fleet["router"].fleet_status()["aggregation"][
+        "freshness_seconds"] == 1.5
+    text = fleet_to_prometheus(router.fleet_status())
+    assert "elasticdl_agg_freshness_seconds 1.5" in text
+    assert "elasticdl_agg_published_version 2" in text
+
+
+def test_rollout_refuses_regression_and_repeats_idempotently(fleet):
+    port = fleet["port"]
+    _, out = _post(port, "/fleet/rollout", {"version": 1})
+    assert out["committed"]  # already there: idempotent success
+    _, out = _post(port, "/fleet/rollout", {"version": 0})
+    assert not out["committed"]
+    assert "behind committed" in out["error"]
+
+
+# -- canary ------------------------------------------------------------
+
+
+def test_canary_cohort_isolation_then_barrier_clean_promote(fleet):
+    router, port, base = (fleet["router"], fleet["port"],
+                          fleet["base"])
+    _export_version(base, 2, bias=1.0)
+    status, out = _post(port, "/fleet/canary",
+                        {"version": 2, "fraction": 0.3})
+    assert status == 200 and out["started"], out
+    assert len(out["replicas"]) == 1  # ceil(0.3 * 3)
+    canary_keys = [k for k in _keys() if canary_slice(k) < 0.3]
+    baseline_keys = [k for k in _keys() if canary_slice(k) >= 0.3]
+    # The deterministic hash puts ~30% of keys on the canary slice.
+    assert 0.2 < len(canary_keys) / len(_keys()) < 0.4
+    for key in canary_keys[:8]:
+        assert _predict(port, key) == (200, 2)
+    for key in baseline_keys[:8]:
+        assert _predict(port, key) == (200, 1)
+    cohorts = router.cohort_stats()
+    assert cohorts["canary"]["keyed_requests"] == 8
+    assert cohorts["canary"]["model_version"] == 2
+    assert cohorts["baseline"]["model_version"] == 1
+    status, out = _post(port, "/fleet/canary/promote", {})
+    assert out["promoted"] and out["committed_version"] == 2
+    assert router.canary_view() is None
+    assert _wait(lambda: len(router.state.routable(2)) == 3)
+    # Post-promote: every key sees version 2 — and no key ever saw a
+    # version regression (canary keys went 2 -> 2, baseline 1 -> 2).
+    for key in canary_keys[:4] + baseline_keys[:4]:
+        assert _predict(port, key) == (200, 2)
+
+
+def test_canary_rollback_returns_replicas_to_committed(fleet):
+    router, port, base = (fleet["router"], fleet["port"],
+                          fleet["base"])
+    _export_version(base, 2, bias=1.0)
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.34})
+    assert out["started"]
+    canary_addrs = set(out["replicas"])
+    canary_key = next(k for k in _keys() if canary_slice(k) < 0.34)
+    assert _predict(port, canary_key) == (200, 2)
+    status, out = _post(port, "/fleet/canary/rollback", {})
+    assert out["rolled_back"] and set(out["healed"]) == canary_addrs
+    assert router.canary_view() is None
+    assert router.coordinator.committed_version == 1
+    # The rolled-back replicas serve the committed version again and
+    # rejoin the one routable pool.
+    assert _wait(lambda: len(router.state.routable(1)) == 3)
+    assert _predict(port, canary_key) == (200, 1)
+
+
+def test_canary_fallback_counts_as_baseline_evidence(fleet):
+    """A dead canary pool must not mint canary evidence: fallback
+    requests are served by baseline replicas at the committed version,
+    so they count (and version-stamp) as baseline."""
+    router, port, base = (fleet["router"], fleet["port"],
+                          fleet["base"])
+    _export_version(base, 2, bias=1.0)
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.3})
+    assert out["started"]
+    # Kill the WHOLE canary pool mid-soak.
+    for canary_addr in out["replicas"]:
+        next(r for r in fleet["replicas"]
+             if r.addr == canary_addr).kill()
+    assert _wait(lambda: not any(
+        router.state.replica_row(a)["healthy"]
+        for a in out["replicas"]))
+    before = router.cohort_stats()
+    canary_key = next(k for k in _keys() if canary_slice(k) < 0.3)
+    status, version = _predict(port, canary_key)
+    assert (status, version) == (200, 1)  # served by baseline
+    after = router.cohort_stats()
+    assert after["canary"]["requests"] == before["canary"]["requests"]
+    assert (after["baseline"]["requests"]
+            == before["baseline"]["requests"] + 1)
+    _, counters = router.state.snapshot()
+    assert counters.get("router.canary_fallback", 0) >= 1
+    _post(port, "/fleet/canary/rollback", {})
+
+
+def test_seed_committed_is_modal_not_max():
+    """A router restarting mid-canary must not adopt the lone canary
+    replica's unvetted version as the fleet's committed one."""
+    from elasticdl_tpu.serving.fleet import FleetCoordinator
+
+    state = FleetState(["a:1", "b:2", "c:3"], probe_interval=9999)
+    now = time.monotonic()
+    state.note_probe_ok("a:1", {"models": {"m": {"version": 10}}}, now)
+    state.note_probe_ok("b:2", {"models": {"m": {"version": 10}}}, now)
+    state.note_probe_ok("c:3", {"models": {"m": {"version": 11}}}, now)
+    coordinator = FleetCoordinator(state, "")
+    assert coordinator.seed_committed()
+    assert coordinator.committed_version == 10  # majority, not max
+    # A 1-vs-1 tie keeps the MAX: that split is also the
+    # lagging-rejoiner shape, whose heal-up is the PR-9 guarantee.
+    state2 = FleetState(["a:1", "b:2"], probe_interval=9999)
+    state2.note_probe_ok("a:1", {"models": {"m": {"version": 10}}},
+                         now)
+    state2.note_probe_ok("b:2", {"models": {"m": {"version": 11}}},
+                         now)
+    coordinator2 = FleetCoordinator(state2, "")
+    assert coordinator2.seed_committed()
+    assert coordinator2.committed_version == 11
+
+
+def test_canary_explicit_replica_list_is_validated(fleet):
+    port, base = fleet["port"], fleet["base"]
+    _export_version(base, 2, bias=1.0)
+    addrs = [r.addr for r in fleet["replicas"]]
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.3,
+                    "replicas": ["127.0.0.1:1"]})
+    assert not out["started"] and "not routable" in out["error"]
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.3, "replicas": addrs})
+    assert not out["started"] and "baseline" in out["error"]
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.3,
+                    "replicas": addrs[:1]})
+    assert out["started"] and out["replicas"] == addrs[:1]
+    _post(port, "/fleet/canary/rollback", {})
+
+
+def test_publish_only_mode_still_runs_retention(tmp_path):
+    from elasticdl_tpu.aggregation import ModelAggregator
+    from elasticdl_tpu.aggregation.main import run_loop
+    from elasticdl_tpu.serving.export import ContinuousExporter
+    from elasticdl_tpu.serving.loader import list_versions
+
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = ContinuousExporter(str(src), model_name="lin",
+                            platforms=("cpu",))
+    W = np.full((4, 2), 1.0, np.float32)
+
+    def export(version):
+        ce.export(version, lambda p, x: x @ p["w"], {"w": W},
+                  np.zeros((1, 4), np.float32))
+
+    agg = ModelAggregator(str(src), str(pub), window=1,
+                          mode="latest", export_keep=1)
+    stop = threading.Event()
+    runner = threading.Thread(
+        target=run_loop, args=(agg, stop),
+        kwargs={"router": None, "poll_interval": 0.05}, daemon=True)
+    runner.start()
+    # Staggered exports -> three separate publishes.
+    for version in (1, 2, 3):
+        export(version)
+        assert _wait(lambda v=version: agg.stats()
+                     ["last_published_version"] == v, 20)
+    stop.set()
+    runner.join(timeout=10)
+    # keep=1 with the newest publish as the floor: 1 and 2 are GC'd.
+    assert list_versions(str(pub)) == [3]
+
+
+def test_canary_input_validation(fleet):
+    router, port, base = (fleet["router"], fleet["port"],
+                          fleet["base"])
+    _export_version(base, 2, bias=1.0)
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 1.5})
+    assert not out["started"] and "fraction" in out["error"]
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 1, "fraction": 0.3})
+    assert not out["started"] and "not ahead" in out["error"]
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.3})
+    assert out["started"]
+    # One canary at a time; rollouts are refused while it runs.
+    _, out = _post(port, "/fleet/canary",
+                   {"version": 2, "fraction": 0.3})
+    assert not out["started"] and "already active" in out["error"]
+    _, out = _post(port, "/fleet/rollout", {"version": 2})
+    assert not out["committed"] and "canary active" in out["error"]
+    _post(port, "/fleet/canary/rollback", {})
+
+
+def test_canary_needs_a_baseline_replica(tmp_path):
+    base = tmp_path / "exports"
+    _export_version(base, 1)
+    replica = _Replica(base)
+    router = Router([replica.addr], export_dir=str(base),
+                    probe_interval=0.05, poll_interval=0.1,
+                    auto_rollout=False)
+    router.start(coordinate=True)
+    try:
+        assert _wait(
+            lambda: len(router.state.routable(1)) == 1)
+        _export_version(base, 2, bias=1.0)
+        out = router.start_canary(2, 0.5)
+        assert not out["started"]  # a 1-replica fleet can't slice
+    finally:
+        router.stop()
+        replica.close()
+
+
+def test_canary_metrics_and_label_escaping():
+    """fleet_to_prometheus renders the canary series through the ONE
+    prometheus_line renderer — label escaping included."""
+    status = {
+        "committed_version": 3,
+        "replicas": {}, "counters": {},
+        "canary": {
+            "active": True, "version": 4, "fraction": 0.25,
+            "replicas": ["a:1"],
+            "cohorts": {
+                'weird"cohort\n': {"requests": 2, "keyed_requests": 1,
+                                   "errors": 1,
+                                   "latency_ms_sum": 10.0,
+                                   "model_version": 4},
+            },
+        },
+        "aggregation": {"freshness_seconds": 2.5, "version": 4},
+    }
+    text = fleet_to_prometheus(status)
+    assert "elasticdl_fleet_canary_active 1" in text
+    assert "elasticdl_fleet_canary_version 4" in text
+    assert "elasticdl_fleet_canary_fraction 0.25" in text
+    assert ('elasticdl_fleet_canary_requests'
+            '{cohort="weird\\"cohort\\n"} 2') in text
+    assert ('elasticdl_fleet_canary_latency_ms'
+            '{cohort="weird\\"cohort\\n"} 5.0') in text
+    assert "elasticdl_agg_freshness_seconds 2.5" in text
+
+
+# -- autoscaler --------------------------------------------------------
+
+
+class _FakeRouter:
+    def __init__(self, addrs, committed=1):
+        self.state = FleetState(addrs, probe_interval=9999)
+        self.committed = committed
+        self.added = []
+        self.removed = []
+
+    def committed_view(self):
+        return self.committed
+
+    def add_replica(self, addr):
+        self.state.add_replica(addr)
+        self.added.append(addr)
+
+    def remove_replica(self, addr):
+        self.state.remove_replica(addr)
+        self.removed.append(addr)
+
+    def canary_addrs(self):
+        return frozenset()
+
+
+class _FakeSpawner:
+    def __init__(self):
+        self.spawned = []
+        self.drained = []
+        self.reaped = []
+
+    def spawn(self, boot_version=None):
+        addr = "spawned:%d" % len(self.spawned)
+        self.spawned.append((addr, boot_version))
+        return addr
+
+    def drain(self, addr):
+        self.drained.append(addr)
+
+    def reap(self, addr, timeout=0):
+        self.reaped.append(addr)
+
+
+def _statz(queue_count, queue_sum_s, version=1, draining=False):
+    return {
+        "draining": draining,
+        "models": {"m": {
+            "version": version,
+            "timing": {"batcher.queue_wait": {
+                "count": queue_count,
+                "mean_s": (queue_sum_s / queue_count)
+                if queue_count else 0.0,
+            }},
+        }},
+    }
+
+
+def _feed(state, addr, count, total_s, now):
+    state.note_probe_ok(addr, _statz(count, total_s), now)
+
+
+def _scaler(router, spawner, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("scale_up_queue_ms", 25.0)
+    kw.setdefault("scale_down_queue_ms", 2.0)
+    kw.setdefault("breach_secs", 2.0)
+    kw.setdefault("idle_secs", 5.0)
+    kw.setdefault("cooldown_secs", 4.0)
+    return FleetAutoscaler(router, spawner, **kw)
+
+
+def test_probe_differencing_yields_recent_queue_wait():
+    state = FleetState(["a:1"], probe_interval=1)
+    _feed(state, "a:1", 100, 10.0, now=0)   # lifetime mean 100ms
+    _feed(state, "a:1", 150, 10.5, now=1)   # recent: 0.5s / 50 = 10ms
+    row = state.replica_row("a:1")
+    assert row["queue_wait_recent_ms"] == pytest.approx(10.0)
+    _feed(state, "a:1", 150, 10.5, now=2)   # idle interval
+    assert state.replica_row("a:1")[
+        "queue_wait_recent_ms"] == pytest.approx(0.0)
+    _feed(state, "a:1", 5, 0.1, now=3)      # counter reset (restart)
+    assert state.replica_row("a:1")["queue_wait_recent_ms"] is None
+
+
+def test_autoscaler_grows_on_sustained_breach_only():
+    router = _FakeRouter(["a:1"], committed=7)
+    spawner = _FakeSpawner()
+    scaler = _scaler(router, spawner)
+    _feed(router.state, "a:1", 10, 1.0, now=0)    # 100ms recent wait
+    scaler.tick(now=0.0)
+    scaler.tick(now=1.0)
+    assert spawner.spawned == []                  # not sustained yet
+    scaler.tick(now=2.5)
+    assert [a for a, _ in spawner.spawned] == ["spawned:0"]
+    # Spawn boots pinned to the committed version; admitted to table.
+    assert spawner.spawned[0][1] == 7
+    assert router.added == ["spawned:0"]
+    # Cooldown: the breach persists but no second spawn yet.
+    scaler.tick(now=3.0)
+    assert len(spawner.spawned) == 1
+
+
+def test_autoscaler_respects_max_replicas():
+    router = _FakeRouter(["a:1", "b:2", "c:3"])
+    spawner = _FakeSpawner()
+    scaler = _scaler(router, spawner, max_replicas=3)
+    for addr in ("a:1", "b:2", "c:3"):
+        _feed(router.state, addr, 10, 5.0, now=0)
+    scaler.tick(now=0.0)
+    scaler.tick(now=10.0)
+    assert spawner.spawned == []
+
+
+def test_autoscaler_shrinks_idle_fleet_after_drain_completes():
+    router = _FakeRouter(["a:1", "b:2"])
+    spawner = _FakeSpawner()
+    scaler = _scaler(router, spawner)
+    for now in (0.0, 6.0):
+        for addr in ("a:1", "b:2"):
+            _feed(router.state, addr, 10, 0.0, now=now)
+        scaler.tick(now=now)
+    assert spawner.drained == ["a:1"]  # idle for >= idle_secs
+    assert router.removed == []        # NOT removed until drained
+    # A forward admitted BEFORE the drain flag landed is still live
+    # when the replica starts reporting draining.
+    assert router.state.acquire(None, members={"a:1"}) == "a:1"
+    router.state.note_probe_ok("a:1", _statz(10, 0.0, draining=True),
+                               7.0)
+    scaler.tick(now=8.0)
+    assert router.removed == []        # in-flight forward pending
+    router.state.forward_finished("a:1")
+    scaler.tick(now=9.0)
+    assert router.removed == ["a:1"]
+    assert spawner.reaped == ["a:1"]
+
+
+def test_autoscaler_reaps_crashed_spawn_and_replaces_it():
+    router = _FakeRouter(["a:1", "spawned:0"], committed=3)
+    spawner = _FakeSpawner()
+    # The spawner "owns" spawned:0 and reports its process exited.
+    spawner.spawned.append(("spawned:0", 3))
+    spawner.addrs = lambda: ["spawned:0"]
+    spawner.poll = lambda addr: 1  # crashed
+    scaler = _scaler(router, spawner, min_replicas=2)
+    _feed(router.state, "a:1", 10, 0.05, now=0)
+    scaler.tick(now=0.0)
+    # The corpse left the table (it no longer burns a max_replicas
+    # slot) and the fleet dropped below min -> replaced immediately.
+    assert router.removed == ["spawned:0"]
+    assert spawner.reaped == ["spawned:0"]
+    assert [a for a, _ in spawner.spawned[1:]] == ["spawned:1"]
+    # An operator-provided replica (not in spawner.addrs) is never
+    # reaped, however dead it looks.
+    assert "a:1" not in router.removed
+
+
+def test_canary_refused_in_routing_only_mode(tmp_path):
+    base = tmp_path / "exports"
+    _export_version(base, 1)
+    replicas = [_Replica(base) for _ in range(2)]
+    router = Router([r.addr for r in replicas],
+                    probe_interval=0.05, poll_interval=0.1)
+    router.start()  # routing-only: no export_dir
+    try:
+        assert _wait(lambda: len(router.state.routable(None)) == 2)
+        # Fails FAST (routing-only mode runs no rollout thread — a
+        # queued command must not wait out its whole timeout), and
+        # says why.
+        start = time.monotonic()
+        out = router.start_canary(2, 0.5)
+        assert time.monotonic() - start < 5.0
+        assert not out.get("started")
+        assert "coordination" in out["error"]
+        assert "coordination" in router.external_rollout(2)["error"]
+    finally:
+        router.stop()
+        for replica in replicas:
+            replica.close()
+
+
+def test_autoscaler_never_shrinks_an_operator_replica():
+    """spawner.drain() is a no-op for a replica it does not own —
+    'draining' one would force-remove a live operator-managed replica
+    at drain_timeout.  Only spawner-owned replicas are candidates."""
+    router = _FakeRouter(["op:1", "spawned:0"])
+    spawner = _FakeSpawner()
+    spawner.spawned.append(("spawned:0", 1))
+    spawner.addrs = lambda: ["spawned:0"]
+    spawner.poll = lambda addr: None  # alive
+    scaler = _scaler(router, spawner)
+    for now in (0.0, 6.0):
+        for addr in ("op:1", "spawned:0"):
+            _feed(router.state, addr, 10, 0.0, now=now)
+        scaler.tick(now=now)
+    assert spawner.drained == ["spawned:0"]
+
+
+class _FakeFleet:
+    """RouterClient-shaped stub for drive_rollout unit tests."""
+
+    def __init__(self, canary_active=False, canary_requests=0):
+        self.calls = []
+        self.committed = 1
+        self._active = canary_active
+        self._requests = canary_requests
+
+    def rollout(self, version, freshness=None):
+        self.calls.append(("rollout", version))
+        if self._active:
+            return {"committed": False,
+                    "error": "canary active (version 9); promote or "
+                             "roll back first"}
+        self.committed = version
+        return {"committed": True, "committed_version": version}
+
+    def canary_start(self, version, fraction, freshness=None):
+        self.calls.append(("canary_start", version))
+        if self._active:
+            return {"started": False,
+                    "error": "canary already active (version 9)"}
+        return {"started": True}
+
+    def canary_promote(self):
+        self.calls.append(("promote",))
+        self.committed = 9
+        return {"promoted": True}
+
+    def canary_rollback(self):
+        self.calls.append(("rollback",))
+        self._active = False
+        return {"rolled_back": True}
+
+    def status(self):
+        return {"canary": {"cohorts": {"canary": {
+            "requests": self._requests, "errors": 0}}}}
+
+    def committed_version(self):
+        return self.committed
+
+
+def test_drive_rollout_recovers_from_stale_canary():
+    from elasticdl_tpu.aggregation.main import drive_rollout
+
+    # Plain-rollout path: refused by a standing canary -> rolled back
+    # and retried, so one failed promote can't wedge every later
+    # publish.
+    fleet = _FakeFleet(canary_active=True)
+    floor = drive_rollout(fleet, 12)
+    assert ("rollback",) in fleet.calls
+    assert fleet.calls.count(("rollout", 12)) == 2
+    assert floor == 12
+    # Canary path: 'already active' rolls the stale slice back first.
+    fleet2 = _FakeFleet(canary_active=True)
+    drive_rollout(fleet2, 12, canary_fraction=0.3,
+                  canary_soak_secs=0.01)
+    assert ("rollback",) in fleet2.calls
+    assert fleet2.committed == 12
+
+
+def test_canary_with_no_soak_evidence_rolls_back():
+    from elasticdl_tpu.aggregation.main import drive_rollout
+
+    # Zero canary traffic during the soak: no evidence, no promote.
+    fleet = _FakeFleet(canary_requests=0)
+    drive_rollout(fleet, 12, canary_fraction=0.3,
+                  canary_soak_secs=0.01)
+    assert ("rollback",) in fleet.calls
+    assert ("promote",) not in fleet.calls
+    # A shutdown mid-soak must not promote an unvalidated version.
+    stop = threading.Event()
+    stop.set()
+    fleet2 = _FakeFleet(canary_requests=50)
+    drive_rollout(fleet2, 12, canary_fraction=0.3,
+                  canary_soak_secs=5.0, stop_event=stop)
+    assert ("promote",) not in fleet2.calls
+    assert ("rollback",) in fleet2.calls
+
+
+def test_autoscaler_never_shrinks_below_min_or_drains_canary():
+    router = _FakeRouter(["a:1", "b:2"])
+    router.canary_addrs = lambda: frozenset(["a:1"])
+    spawner = _FakeSpawner()
+    scaler = _scaler(router, spawner, min_replicas=2)
+    for now in (0.0, 6.0):
+        for addr in ("a:1", "b:2"):
+            _feed(router.state, addr, 10, 0.0, now=now)
+        scaler.tick(now=now)
+    assert spawner.drained == []  # min_replicas=2 floors the fleet
+    scaler2 = _scaler(router, spawner, min_replicas=1)
+    for now in (20.0, 26.0):
+        scaler2.tick(now=now)
+    # Only the non-canary replica is a shrink candidate.
+    assert spawner.drained == ["b:2"]

@@ -1,0 +1,113 @@
+"""Loader robustness (serving/loader.py + the atomic export publish):
+partial version dirs, staging leftovers, corrupt manifests, GC'd
+pinned versions — the states a crashing writer or a retention pass can
+leave behind, which the fleet scanner and the aggregation tier must
+ride without ever serving a torn export."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.serving.export import export_servable, publish_export
+from elasticdl_tpu.serving.loader import (
+    list_versions,
+    load_servable,
+    resolve_export_dir,
+)
+
+W = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+
+def _export(base, version):
+    export_servable(
+        os.path.join(str(base), str(version)),
+        lambda p, x: x @ p["w"], {"w": W},
+        np.zeros((1, 4), np.float32), model_name="lin",
+        version=version, platforms=("cpu",),
+    )
+
+
+def test_atomic_publish_leaves_no_staging_dirs(tmp_path):
+    _export(tmp_path, 1)
+    assert sorted(os.listdir(tmp_path)) == ["1"]
+    assert sorted(os.listdir(tmp_path / "1")) == [
+        "manifest.json", "model.npz", "model.stablehlo"]
+
+
+def test_publish_export_swaps_existing_dir_whole(tmp_path):
+    target = tmp_path / "1"
+    publish_export(str(target), {"manifest.json": b"{}",
+                                 "old_leaf": b"x"})
+    publish_export(str(target), {"manifest.json": b"{}",
+                                 "new_leaf": b"y"})
+    # The old dir's contents never mix into the new one.
+    assert sorted(os.listdir(target)) == ["manifest.json", "new_leaf"]
+    assert sorted(os.listdir(tmp_path)) == ["1"]
+
+
+def test_partial_version_dir_is_skipped(tmp_path):
+    _export(tmp_path, 1)
+    _export(tmp_path, 3)
+    # A torn pre-atomic export: leaf files, no manifest.
+    os.makedirs(tmp_path / "5")
+    (tmp_path / "5" / "model.npz").write_bytes(b"junk")
+    assert list_versions(str(tmp_path)) == [1, 3]
+    assert resolve_export_dir(str(tmp_path)).endswith("/3")
+
+
+def test_tmp_leftovers_skipped_and_gc_reaps_them(tmp_path):
+    _export(tmp_path, 2)
+    os.makedirs(tmp_path / "4.tmp-12345")
+    os.makedirs(tmp_path / "4.old-12345")
+    os.makedirs(tmp_path / "7")  # manifest-less numeric dir
+    # A plain reader never reaps another writer's staging dirs.
+    assert list_versions(str(tmp_path)) == [2]
+    assert (tmp_path / "4.tmp-12345").is_dir()
+    # The base's OWNER reaps staging leftovers and torn numeric dirs;
+    # complete versions stay, and so does the .old- sibling — after a
+    # crash mid-swap it can be the only complete copy of that export.
+    assert list_versions(str(tmp_path), gc_incomplete=True) == [2]
+    assert sorted(os.listdir(tmp_path)) == ["2", "4.old-12345"]
+
+
+def test_pinned_version_after_gc_fails_loudly(tmp_path):
+    _export(tmp_path, 1)
+    _export(tmp_path, 2)
+    assert resolve_export_dir(str(tmp_path), version=1).endswith("/1")
+    import shutil
+
+    shutil.rmtree(tmp_path / "1")  # retention GC took it
+    with pytest.raises(FileNotFoundError):
+        resolve_export_dir(str(tmp_path), version=1)
+    # The unpinned scan still resolves what remains.
+    assert resolve_export_dir(str(tmp_path)).endswith("/2")
+
+
+def test_corrupt_manifest_fails_at_load_not_silently(tmp_path):
+    _export(tmp_path, 1)
+    (tmp_path / "1" / "manifest.json").write_text("{not json")
+    # Presence marks completeness (the atomic publisher can't write a
+    # torn manifest)...
+    assert list_versions(str(tmp_path)) == [1]
+    # ...so corruption surfaces at LOAD, loudly, not as a skip.
+    with pytest.raises(ValueError):
+        load_servable(str(tmp_path / "1"))
+
+
+def test_unknown_format_prefix_refused(tmp_path):
+    _export(tmp_path, 1)
+    manifest_path = tmp_path / "1" / "manifest.json"
+    manifest = json.loads(manifest_path.read_text())
+    manifest["format"] = "future-encoding+" + manifest["format"]
+    manifest_path.write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="feature prefixes"):
+        load_servable(str(tmp_path / "1"))
+
+
+def test_direct_export_dir_still_resolves(tmp_path):
+    _export(tmp_path, 1)
+    direct = str(tmp_path / "1")
+    assert resolve_export_dir(direct) == direct
+    assert list_versions(direct) == []
