@@ -571,7 +571,7 @@ def run_ps_kill_drill(records=1024, deadline_secs=300):
     return out
 
 
-def run_multitenant_drill(records_a=1024, records_b=3072,
+def run_multitenant_drill(records_a=1024, records_b=4096,
                           deadline_secs=300):
     """The multi-tenant scheduler drill (docs/scheduler.md): TWO jobs
     over ONE shared 4-worker pool, with a controller-driven resize and
@@ -597,7 +597,14 @@ def run_multitenant_drill(records_a=1024, records_b=3072,
       - trace connectivity: one component holds the resize decision
         (``sched.resize``), the drained worker's re-register
         (``sched.worker_reassigned``, link_trace) and the worker's
-        in-place rebuild (``worker.job_switch``)"""
+        in-place rebuild (``worker.job_switch``)
+      - STRAGGLER gate (ISSUE 14): worker 1 is DELIBERATELY throttled
+        (ELASTICDL_STEP_THROTTLE_SPEC) — the restarted master's
+        straggler sweep must flag it (observed on /status within the
+        drill window, or post-hoc via the journal-independent trace
+        dump), and the default ``value(straggler_workers) < 1`` SLO
+        rule must land an ``slo.breach`` event in the master's flight
+        recorder + show on /alertz."""
     import shutil
     import signal
     import subprocess
@@ -633,11 +640,20 @@ def run_multitenant_drill(records_a=1024, records_b=3072,
              "min_workers": 1, "max_workers": 4, "weight": 1.0},
         ], fh)
     port = find_free_port()
+    status_port = find_free_port()
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu", ELASTICDL_TPU_PLATFORM="cpu",
         ELASTICDL_RPC_DEADLINE_SECS="45",
         ELASTICDL_TRACE_DIR=tdir,
+        # Straggler staging: worker 1 (one member of the shared pool,
+        # targeted by id through the inherited env) sleeps 500 ms per
+        # step — ~4x this rig's ~170 ms per-step-loop CPU mnist step,
+        # a GROSS straggler that clears the 2.0x ratio bar by a full
+        # log bucket (the p50 estimate quantizes at ~2.15x per
+        # bucket) while still stepping fast enough to fill two
+        # 4-sample sweep windows before its job drains.
+        ELASTICDL_STEP_THROTTLE_SPEC="1:500",
     )
     base_cmd = [
         sys.executable, "-m", "elasticdl_tpu.master.main",
@@ -647,7 +663,19 @@ def run_multitenant_drill(records_a=1024, records_b=3072,
         "--num_epochs", "1",
         "--journal_dir", jdir, "--port", str(port),
         "--sched_cadence_secs", "0.5",
+        "--status_port", str(status_port),
     ]
+
+    def _http_json(path, timeout=2.0):
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d%s" % (status_port, path),
+                    timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except Exception:  # noqa: BLE001 — master between lives
+            return None
     sched_dir = os.path.join(jdir, "sched")
 
     def sched_moves():
@@ -710,12 +738,32 @@ def run_multitenant_drill(records_a=1024, records_b=3072,
                                    env=env, stdout=log_fh,
                                    stderr=subprocess.STDOUT, text=True)
         recovery_secs = None
+        straggler_on_status = False
+        breach_on_alertz = False
         deadline = time.time() + deadline_secs
         while time.time() < deadline:
             scan_workers()
             done_b, _ = job_completed("job-02")
             if recovery_secs is None and done_b >= expected["jobB"]:
                 recovery_secs = time.perf_counter() - t_kill
+            if not straggler_on_status:
+                # The throttled worker on the live /status surface:
+                # the restarted master's sweeps re-flag it from fresh
+                # state; once sustained it STAYS flagged (un-flagging
+                # takes a healthy judged window), so this poll is not
+                # racing a transient.
+                status = _http_json("/status")
+                for job in (status or {}).get("jobs", {}).values():
+                    workers = (job.get("telemetry") or {}).get(
+                        "workers", {})
+                    if any(t.get("straggler")
+                           for t in workers.values()):
+                        straggler_on_status = True
+            if not breach_on_alertz:
+                alertz = _http_json("/alertz")
+                if alertz and "stragglers" in alertz.get(
+                        "breaching", []):
+                    breach_on_alertz = True
             if master2.poll() is not None:
                 break
             time.sleep(0.25)
@@ -782,12 +830,29 @@ def run_multitenant_drill(records_a=1024, records_b=3072,
             required <= {e["name"] for e in c} for c in components
         )
 
+        # Straggler gate (ISSUE 14): flagged live on /status +
+        # breaching on /alertz, AND the slo.breach / worker.straggler
+        # events in the master's dumped flight recorder.
+        names = {e.get("name") for e in events}
+        out["straggler_on_status"] = straggler_on_status
+        out["slo_breach_on_alertz"] = breach_on_alertz
+        out["slo_breach_in_recorder"] = "slo.breach" in names
+        out["straggler_event_in_recorder"] = (
+            "worker.straggler" in names)
+        straggler_gate = (
+            straggler_on_status and breach_on_alertz
+            and out["slo_breach_in_recorder"]
+            and out["straggler_event_in_recorder"]
+        )
+        out["straggler_detected"] = straggler_gate
+
         out["all_records_accounted"] = (
             all(accounted.values())
             and master2.poll() == 0
             and zero_restarts
             and out["resize_moves_total"] >= 1
             and out["trace_connected"]
+            and straggler_gate
         )
         out["per_job_accounted"] = accounted
     finally:

@@ -1,5 +1,6 @@
-"""Tracing overhead benchmark: steps/s with the flight recorder +
-span plane on vs off (utils/tracing.py).
+"""Tracing + histogram-plane overhead benchmark: steps/s with the
+flight recorder + span plane on vs off (utils/tracing.py), and with
+the percentile plane (utils/hist.py) on vs off.
 
 What tracing can slow down is the CONTROL PLANE: every worker-side
 step ends in a report RPC, and with tracing ON each RPC pays a client
@@ -11,6 +12,14 @@ per report against a real gRPC master, tracing on vs off (the
 ``ELASTICDL_TRACING`` switch the Tracer reads).  A zero-compute
 report-path hammer bounds the worst case (pure control-plane rate with
 no training between reports).
+
+The HISTOGRAM leg (ISSUE 14): same harness, flipping
+``hist.set_enabled`` instead of the tracing switch — each step
+observes its wall time into a Timing-backed histogram, encodes the
+sparse delta, and the report RPC carries it to a master that decodes
+and exact-merges it (the full percentile-plane path: observe -> bisect
+-> encode -> wire -> decode -> merge), vs the identical loop with the
+histogram path globally off.  Same <= 2% steps/s gate.
 
 Harness matches bench_journal.py / bench_zero.py: interleaved timed
 blocks with per-pair leg-order alternation, gate = MEDIAN of per-block
@@ -108,6 +117,54 @@ def run_train_block(tracing_on, trainer, data):
     return MINIBATCHES_PER_TASK / _median(task_secs)
 
 
+def run_hist_block(hist_on, trainer, data):
+    """Histogram-plane leg: tracing stays at its default; the
+    percentile plane flips.  Each minibatch observes its wall time
+    into a Timing (bisect + bucket increment), and every progress RPC
+    carries the encoded sparse delta to the master, which decodes and
+    exact-merges it — the complete worker->master histogram path."""
+    from elasticdl_tpu.utils import hist
+    from elasticdl_tpu.utils.timing import Timing
+
+    hist.set_enabled(bool(hist_on))
+    mc, finish = _master(TASKS_PER_BLOCK)
+    timing = Timing()
+    task_secs = []
+    steps = 0
+    prev_snap = None
+    try:
+        while True:
+            t0 = time.perf_counter()
+            task = mc.get_task()
+            if task.id < 0:
+                break
+            t_prev = time.perf_counter()
+            for _ in range(MINIBATCHES_PER_TASK):
+                loss, _ = trainer.train_minibatch(
+                    *data[steps % len(data)])
+                float(loss)
+                t_now = time.perf_counter()
+                timing.observe("step_time", t_now - t_prev)
+                t_prev = t_now
+                telemetry = {"steps_per_sec": 1.0,
+                             "steps_done": steps + 1}
+                snap = timing.hist_snapshot("step_time")
+                if snap is not None:
+                    d = hist.delta(snap, prev_snap)
+                    prev_snap = snap
+                    if d["count"]:
+                        telemetry["hist_delta"] = hist.encode_deltas(
+                            {"step_time": d})
+                mc.report_batch_done(BATCH_SIZE, telemetry=telemetry)
+                steps += 1
+            mc.report_task_result(task.id)
+            task_secs.append(time.perf_counter() - t0)
+    finally:
+        hist.set_enabled(True)
+    finish()
+    return MINIBATCHES_PER_TASK / _median(task_secs)
+
+
 def run_hammer_block(tracing_on):
     """Worst-case bound: the report path with NO compute between
     reports (reports/s, per-task median)."""
@@ -176,11 +233,15 @@ def main():
     train_pairs = _interleaved_pairs(
         lambda on: run_train_block(on, trainer, data), BLOCK_PAIRS
     )
+    hist_pairs = _interleaved_pairs(
+        lambda on: run_hist_block(on, trainer, data), BLOCK_PAIRS
+    )
     hammer_pairs = _interleaved_pairs(run_hammer_block, BLOCK_PAIRS)
 
     ratio = _median([on / off for on, off in train_pairs])
     on_med = _median([p[0] for p in train_pairs])
     off_med = _median([p[1] for p in train_pairs])
+    hist_ratio = _median([on / off for on, off in hist_pairs])
     h_ratio = _median([on / off for on, off in hammer_pairs])
     h_on = _median([p[0] for p in hammer_pairs])
     h_off = _median([p[1] for p in hammer_pairs])
@@ -204,6 +265,19 @@ def main():
                  "ratio": round(on / off, 4)}
                 for on, off in train_pairs
             ],
+            "histogram_path": {
+                "note": "percentile plane on/off (utils/hist.py "
+                        "switch): per-step observe + sparse-delta "
+                        "encode on the worker, decode + exact merge "
+                        "on the master, all through real gRPC",
+                "steps_ratio": round(hist_ratio, 4),
+                "within_2pct": 0.98 <= hist_ratio,
+                "blocks": [
+                    {"on": round(on, 1), "off": round(off, 1),
+                     "ratio": round(on / off, 4)}
+                    for on, off in hist_pairs
+                ],
+            },
             "report_hammer_worst_case": {
                 "note": "zero compute between reports — pure "
                         "control-plane rate; bounds any cadence",

@@ -55,6 +55,7 @@ import numpy as np
 
 from elasticdl_tpu.serving.export import _npz_bytes, publish_export
 from elasticdl_tpu.serving.loader import list_versions
+from elasticdl_tpu.utils import slo as slo_mod
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 
@@ -110,6 +111,24 @@ class ModelAggregator:
         self._stats_lock = threading.Lock()
         self._counters = collections.Counter()
         self._freshness = None
+        # The freshness SLO as a FIRST-CLASS rule (utils/slo.py): the
+        # watchdog evaluates it on every publish — a breach emits the
+        # ``slo.breach`` flight-recorder event and counts an episode;
+        # the per-evaluation verdict keeps the historical
+        # ``slo_misses`` counter exact (one miss per over-SLO
+        # publish).  Own instance: several aggregators in one process
+        # (tests) must not share rule state.
+        self.watchdog = slo_mod.SloWatchdog()
+        self.watchdog.add_source("freshness", self._freshness_value)
+        self.watchdog.add_rule(
+            "value(freshness) < %s" % self.freshness_slo_secs,
+            name="agg_freshness",
+            description="publish freshness (publish wall - export "
+                        "birth) within the --freshness_slo_secs SLO")
+
+    def _freshness_value(self):
+        with self._stats_lock:
+            return self._freshness
 
     # -- cross-thread surface ------------------------------------------
 
@@ -307,7 +326,12 @@ class ModelAggregator:
         with self._stats_lock:
             self._freshness = freshness
             self._counters["published"] += 1
-        if freshness > self.freshness_slo_secs:
+        # The watchdog IS the miss detector now: evaluate once per
+        # publish; a breach episode lands in the flight recorder
+        # (slo.breach) and the per-evaluation verdict drives the
+        # historical slo_misses counter (one per over-SLO publish).
+        verdicts = self.watchdog.evaluate()
+        if verdicts.get("agg_freshness", {}).get("breached_now"):
             self.bump("slo_misses")
             logger.warning(
                 "publish freshness %.2fs exceeds SLO %.2fs "

@@ -513,9 +513,28 @@ def build_multitenant_master(args):
     )
 
 
+def _arm_master_slo(servicers):
+    """Default master SLO: zero sustained stragglers (the acceptance
+    objective the straggler detector feeds — a flagged worker IS a
+    breach on /alertz and an ``slo.breach`` flight-recorder event),
+    plus any operator rules from $ELASTICDL_SLO_SPEC."""
+    from elasticdl_tpu.utils import slo as slo_mod
+
+    wd = slo_mod.default_watchdog()
+    wd.add_source(
+        "straggler_workers",
+        lambda: float(sum(len(s.stragglers()) for s in servicers())))
+    wd.add_rule("value(straggler_workers) < 1", name="stragglers",
+                description="no worker sustained-flagged as a "
+                            "straggler (cross-worker step-time skew)")
+    wd.arm_from_env()
+
+
 def _run_multitenant(args):
     master = build_multitenant_master(args)
     master.prepare()
+    _arm_master_slo(
+        lambda: [job.servicer for job in master.registry.jobs()])
     status_server = None
     if args.status_port >= 0:
         from elasticdl_tpu.master.status_server import (
@@ -548,6 +567,7 @@ def main(argv=None):
         return _run_multitenant(args)
     master = build_master(args)
     master.prepare()
+    _arm_master_slo(lambda: [master.servicer])
     status_server = None
     if args.status_port >= 0:
         from elasticdl_tpu.master.status_server import StatusServer

@@ -10,6 +10,7 @@ import threading
 import time
 
 from elasticdl_tpu.master.servicer import MasterServicer, create_master_service
+from elasticdl_tpu.utils import slo
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -125,6 +126,15 @@ class Master:
                         return 1
                 else:
                     stalled_polls = 0
+                # Straggler sweep + SLO evaluation ride the poll
+                # cadence (the single-job analog of the multi-tenant
+                # ResizeController tick): cross-worker step-time skew
+                # is flagged and the default straggler rule can breach
+                # without any external scraper driving it.
+                if self.servicer is not None:
+                    self.servicer.straggler_sweep()
+                    if slo.default_watchdog().rule_count:
+                        slo.default_watchdog().evaluate()
                 time.sleep(self._poll_secs)
         finally:
             self.stop()

@@ -50,9 +50,10 @@ from elasticdl_tpu.master.journal import journal_events
 from elasticdl_tpu.master.servicer import MasterServicer
 from elasticdl_tpu.master.task_manager import wait_task_pb
 from elasticdl_tpu.proto import elastic_pb2 as pb
-from elasticdl_tpu.utils import tracing
+from elasticdl_tpu.utils import slo, tracing
 from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.timing import Timing
 
 logger = get_logger(__name__)
 
@@ -281,6 +282,11 @@ class JobRegistry:
         self._pending_links = {}    # worker_id -> decision trace id
         self._pool_size = int(pool_size)
         self.decision_counts = defaultdict(int)
+        # Scheduler decision-latency phases (ResizeController observes
+        # its tick/rebalance wall time here); rendered as native
+        # histograms on the multi-tenant /metrics
+        # (elasticdl_sched_decision_seconds{phase=}).
+        self.timing = Timing()
 
     # -- job lifecycle ------------------------------------------------------
 
@@ -617,6 +623,7 @@ class JobRegistry:
             "workers_assigned": {
                 j.spec.name: counts.get(j.job_id, 0) for j in jobs
             },
+            "hists": self.timing.histograms(),
         }
 
 
@@ -637,6 +644,11 @@ class ResizeController:
         self._worker_stale_secs = float(worker_stale_secs)
         self._stopped = threading.Event()
         self._thread = None
+        # Sustained stragglers as of the last sweep (tick-thread
+        # state): the DEWEIGHT policy term — when a shrink must pick
+        # donors from an over-target job, flagged stragglers go first
+        # (moving one costs the donor job its slowest member).
+        self._stragglers = set()
 
     def start(self):
         self._thread = threading.Thread(
@@ -670,7 +682,17 @@ class ResizeController:
 
     def tick(self):
         """One policy pass; synchronous and re-entrant-safe, so tests
-        drive it directly without the thread."""
+        drive it directly without the thread.  Wall time feeds the
+        scheduler decision-latency histogram
+        (elasticdl_sched_decision_seconds{phase="tick"})."""
+        t0 = time.perf_counter()
+        try:
+            return self._tick()
+        finally:
+            self._registry.timing.observe(
+                "tick", time.perf_counter() - t0)
+
+    def _tick(self):
         jobs = self._registry.jobs()
         for job in jobs:
             if job.state == RUNNING and job.task_manager.finished():
@@ -687,6 +709,22 @@ class ResizeController:
                     job.rendezvous.remove_worker(
                         "worker-%d" % worker_id
                     )
+        # Straggler sweep (docs/observability.md): each running job's
+        # servicer differences its per-worker step-time histograms
+        # against the previous sweep — the controller tick IS the
+        # sweep cadence, so a deliberately slow worker is flagged
+        # within one cadence of reporting skewed deltas.
+        stragglers = set()
+        for job in self._registry.jobs():
+            if job.state == RUNNING:
+                stragglers.update(job.servicer.straggler_sweep())
+        self._stragglers = stragglers
+        # SLO watchdog rides the policy cadence: breaches (e.g. the
+        # default straggler rule) land in the flight recorder the
+        # moment the sweep that caused them ran — /alertz reads are
+        # then a view, not the trigger.
+        if slo.default_watchdog().rule_count:
+            slo.default_watchdog().evaluate()
         self._registry.admit_pending()
         return self._rebalance()
 
@@ -734,13 +772,17 @@ class ResizeController:
                 job.job_id, 0
             )
             if excess > 0:
+                # Straggler deweight: a sustained straggler is the
+                # preferred donor (the donor job sheds its slowest
+                # member), then newest-first as before.
                 owned = sorted(
                     (w for w, j in assignments.items()
                      if j == job.job_id),
-                    reverse=True,
+                    key=lambda w: (w not in self._stragglers, -w),
                 )
                 over.extend(owned[:excess])
-        donors.extend(sorted(over, reverse=True))
+        donors.extend(sorted(
+            over, key=lambda w: (w not in self._stragglers, -w)))
         receivers = sorted(
             (j for j in running
              if targets.get(j.job_id, 0) > counts.get(j.job_id, 0)),

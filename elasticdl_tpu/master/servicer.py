@@ -6,17 +6,40 @@ logic for idle workers, task result accounting, rendezvous rank queries,
 train-loop membership, evaluation metric ingestion and version reports.
 """
 
+import functools
 import threading
 import time
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.proto import rpc
 from elasticdl_tpu.utils import grpc_utils, tensor_codec, tracing
+from elasticdl_tpu.utils import hist as hist_mod
 from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.timing import Timing
 from elasticdl_tpu.master.task_manager import wait_task_pb
 
 logger = get_logger(__name__)
+
+
+def _timed_rpc(method):
+    """Feed each handled RPC's wall time into the servicer's Timing —
+    behind the mean sits a histogram (utils/hist.py), so the master's
+    RPC handle time is a real p99 on /metrics
+    (elasticdl_master_rpc_handle_seconds{method=}).  Durations are
+    measured with local starts (concurrent handler threads — the
+    shared timeit starts dict would corrupt)."""
+    name = "rpc." + method.__name__
+
+    @functools.wraps(method)
+    def wrapper(self, request, _context=None):
+        t0 = time.perf_counter()
+        try:
+            return method(self, request, _context)
+        finally:
+            self.timing.observe(name, time.perf_counter() - t0)
+
+    return wrapper
 
 
 class MasterServicer:
@@ -58,6 +81,19 @@ class MasterServicer:
         # the sensor input the multi-tenant resize controller (ROADMAP
         # item 5) reads from /status and /metrics.
         self.worker_telemetry = {}
+        # Handle-time phases for the hot control-plane RPCs
+        # (_timed_rpc); .histograms() renders on /metrics.
+        self.timing = Timing()
+        # Per-worker / per-job step-time distributions: EXACT merges
+        # of the sparse histogram deltas workers piggyback on progress
+        # RPCs (utils/hist.py fixed bounds — true p50/p99, not means
+        # of means), plus the straggler detector's sweep state
+        # (docs/observability.md).  All under self._lock.
+        self.worker_step_hist = {}     # worker_id -> snapshot dict
+        self.job_step_hist = hist_mod.empty_snapshot()
+        self._straggler_prev = {}      # worker_id -> snapshot at sweep
+        self._straggler_state = {}     # worker_id -> {"flagged": n,
+        #                                "p50_ms": x, "ratio": r}
         # PS recovery state from generation-tagged version reports
         # (docs/ps_recovery.md): ps_id -> {generation, version,
         # durable_version}.  Observability only (status page, drills);
@@ -81,6 +117,7 @@ class MasterServicer:
     # -- task dispatch ------------------------------------------------------
 
     @rpc_error_guard
+    @_timed_rpc
     def get_task(self, request, _context=None):
         res = pb.GetTaskResponse()
         task = self._task_manager.get(request.worker_id)
@@ -97,6 +134,7 @@ class MasterServicer:
         return res
 
     @rpc_error_guard
+    @_timed_rpc
     def report_task_result(self, request, _context=None):
         success = not request.err_message
         if request.exec_counters:
@@ -136,6 +174,7 @@ class MasterServicer:
         return pb.Empty()
 
     @rpc_error_guard
+    @_timed_rpc
     def report_batch_done(self, request, _context=None):
         if self._job_id and request.job_id and (
             request.job_id != self._job_id
@@ -178,6 +217,27 @@ class MasterServicer:
                         if t["ts"] < cutoff
                     ]:
                         del self.worker_telemetry[worker_id]
+                        # Step-hist state rides the same eviction: a
+                        # long-dead worker's distribution stays summed
+                        # into the JOB histogram (history is history)
+                        # but leaves the per-worker views.
+                        self.worker_step_hist.pop(worker_id, None)
+                        self._straggler_prev.pop(worker_id, None)
+                        self._straggler_state.pop(worker_id, None)
+            if request.hist_delta:
+                # Compact per-worker histogram deltas piggybacked on
+                # the progress report (utils/hist.py sparse encoding;
+                # fixed shared bucket bounds make the merge EXACT):
+                # per-worker accumulators feed the straggler sweep,
+                # the per-job accumulator feeds the true p50/p99 step
+                # time on /status and /metrics.
+                deltas = hist_mod.decode_deltas(request.hist_delta)
+                step = deltas.get("step_time")
+                if step is not None:
+                    acc = self.worker_step_hist.setdefault(
+                        request.worker_id, hist_mod.empty_snapshot())
+                    hist_mod.merge_delta(acc, step)
+                    hist_mod.merge_delta(self.job_step_hist, step)
         if self._journal is not None:
             self._journal.append(
                 {"ev": "batch", "w": request.worker_id,
@@ -243,7 +303,10 @@ class MasterServicer:
     def telemetry(self, now=None):
         """Copy-safe per-worker + per-job telemetry aggregate: the
         resize-controller sensor surface (/status "telemetry" section,
-        /metrics elasticdl_job_steps_per_sec et al)."""
+        /metrics elasticdl_job_steps_per_sec et al).  Includes the
+        percentile plane: per-worker straggler flags + recent step
+        p50, and the per-job step-time histogram (exact merge of the
+        piggybacked worker deltas)."""
         now = time.time() if now is None else now
         with self._lock:
             dead = [
@@ -253,25 +316,158 @@ class MasterServicer:
             ]
             for worker_id in dead:
                 del self.worker_telemetry[worker_id]
+                self.worker_step_hist.pop(worker_id, None)
+                self._straggler_prev.pop(worker_id, None)
+                self._straggler_state.pop(worker_id, None)
             workers = {
                 worker_id: dict(t)
                 for worker_id, t in self.worker_telemetry.items()
             }
+            straggler = {
+                worker_id: dict(s)
+                for worker_id, s in self._straggler_state.items()
+            }
+            job_hist = dict(self.job_step_hist,
+                            counts=list(self.job_step_hist["counts"]))
         live_rate = 0.0
         reporting = 0
-        for t in workers.values():
+        for worker_id, t in workers.items():
             t["age_secs"] = round(now - t.pop("ts"), 3)
             t["fresh"] = t["age_secs"] <= self.TELEMETRY_STALE_SECS
             if t["fresh"]:
                 reporting += 1
                 live_rate += t["steps_per_sec"]
+            s = straggler.get(worker_id)
+            if s is not None:
+                t["straggler"] = (
+                    s["flagged"] >= self.STRAGGLER_SUSTAIN_SWEEPS
+                )
+                if s.get("p50_ms") is not None:
+                    t["step_p50_ms"] = round(s["p50_ms"], 3)
+        job = {
+            "steps_per_sec": round(live_rate, 3),
+            "workers_reporting": reporting,
+        }
+        if job_hist["count"] > 0:
+            p50 = hist_mod.quantile(job_hist, 0.5)
+            p99 = hist_mod.quantile(job_hist, 0.99)
+            job["step_hist"] = job_hist
+            job["step_time_p50_ms"] = round(1e3 * p50, 3)
+            job["step_time_p99_ms"] = round(1e3 * p99, 3)
         return {
             "workers": workers,
-            "job": {
-                "steps_per_sec": round(live_rate, 3),
-                "workers_reporting": reporting,
-            },
+            "job": job,
         }
+
+    def rpc_histograms(self):
+        """{method: snapshot} of the handled-RPC wall-time histograms
+        (_timed_rpc phases, "rpc." prefix stripped for the label)."""
+        return {
+            name[len("rpc."):]: snap
+            for name, snap in self.timing.histograms().items()
+            if name.startswith("rpc.")
+        }
+
+    # -- straggler detection -------------------------------------------------
+
+    # A worker needs this many step samples in a sweep window to be
+    # judged at all (a worker between tasks must not read as "fast"
+    # or "slow" off two samples)...
+    STRAGGLER_MIN_SAMPLES = 4
+    # ... is FLAGGED when its windowed p50 step time exceeds this
+    # multiple of the cross-worker median ...
+    STRAGGLER_RATIO = 2.0
+    # ... and is a sustained STRAGGLER once flagged in this many
+    # CONSECUTIVE sweeps (one slow window — a GC pause, a checkpoint —
+    # must not trigger policy).
+    STRAGGLER_SUSTAIN_SWEEPS = 2
+
+    def straggler_sweep(self, now=None):
+        """One detector pass over the per-worker step-time deltas
+        since the previous sweep: computes each reporting worker's
+        windowed p50, compares against the cross-worker median, and
+        updates consecutive-flag counts.  Returns the worker ids that
+        are SUSTAINED stragglers right now.  Called at the resize
+        controller's cadence (and by tests directly); needs >= 2
+        workers with enough samples — skew is relative by definition.
+
+        A newly sustained straggler emits a ``worker.straggler``
+        flight-recorder event; policy (deweight / evict) lives in the
+        ResizeController, which treats the returned set as preferred
+        donors (docs/scheduler.md)."""
+        newly = []
+        with self._lock:
+            p50s = {}
+            for worker_id, acc in self.worker_step_hist.items():
+                prev = self._straggler_prev.get(worker_id)
+                d = hist_mod.delta(acc, prev)
+                if d["count"] < self.STRAGGLER_MIN_SAMPLES:
+                    # Below the judgement floor: do NOT rotate the
+                    # mark — the window keeps accumulating until it
+                    # holds enough samples.  (Rotating every sweep
+                    # made any worker slower than MIN_SAMPLES/cadence
+                    # steps per sweep permanently unjudgeable — and a
+                    # straggler is by definition slow.)
+                    continue
+                self._straggler_prev[worker_id] = dict(
+                    acc, counts=list(acc["counts"]))
+                window = hist_mod.empty_snapshot()
+                hist_mod.merge_delta(window, d)
+                p50s[worker_id] = hist_mod.quantile(window, 0.5)
+            for worker_id, p50 in p50s.items():
+                # LEAVE-ONE-OUT median: each worker is judged against
+                # the median of the OTHERS.  A plain all-workers
+                # median caps the reachable ratio at 2.0 in a
+                # two-worker job (the slow worker drags the median up
+                # toward itself), making small jobs' stragglers
+                # undetectable by construction.
+                others = sorted(p for w, p in p50s.items()
+                                if w != worker_id)
+                if not others:
+                    continue
+                mid = len(others) // 2
+                median = (others[mid] if len(others) % 2
+                          else (others[mid - 1] + others[mid]) / 2.0)
+                state = self._straggler_state.setdefault(
+                    worker_id, {"flagged": 0, "p50_ms": None,
+                                "ratio": None})
+                state["p50_ms"] = 1e3 * p50
+                if median > 0:
+                    state["ratio"] = p50 / median
+                    if p50 > self.STRAGGLER_RATIO * median:
+                        state["flagged"] += 1
+                        if state["flagged"] == (
+                                self.STRAGGLER_SUSTAIN_SWEEPS):
+                            newly.append(
+                                (worker_id, state["ratio"]))
+                    else:
+                        state["flagged"] = 0
+            # Workers that reported nothing this window keep their
+            # count (a stalled straggler must not un-flag by going
+            # silent — silence is the stale-eviction sweep's job).
+            sustained = [
+                worker_id
+                for worker_id, s in self._straggler_state.items()
+                if s["flagged"] >= self.STRAGGLER_SUSTAIN_SWEEPS
+            ]
+        for worker_id, ratio in newly:
+            # Outside the lock: recorder event + log for the newly
+            # sustained only (not every sweep re-announces).
+            tracing.event("worker.straggler", worker=worker_id,
+                          job=self._job_id, ratio=round(ratio, 3))
+            logger.warning(
+                "worker %d flagged as straggler (windowed p50 %.1fx "
+                "the cross-worker median)", worker_id, ratio)
+        return sustained
+
+    def stragglers(self):
+        """Currently sustained straggler ids (no sweep — the view)."""
+        with self._lock:
+            return [
+                worker_id
+                for worker_id, s in self._straggler_state.items()
+                if s["flagged"] >= self.STRAGGLER_SUSTAIN_SWEEPS
+            ]
 
     def ps_state(self):
         """Copy-safe snapshot of per-shard PS recovery state for the

@@ -14,6 +14,14 @@ pull surface on the master itself:
   GET /tracez    -> the process flight recorder (utils/tracing.py);
                     ?fmt=chrome renders Chrome trace-event JSON for
                     Perfetto (docs/observability.md)
+  GET /alertz    -> the SLO watchdog's live rule table
+                    (utils/slo.py: value vs threshold, ok, breach
+                    episodes)
+  GET /profilez?secs=N -> capture a jax.profiler trace for N seconds
+                    into $ELASTICDL_TRACE_DIR; the reply (and a
+                    profile.capture flight-recorder event) carries the
+                    capture dir + current trace id, so a Perfetto
+                    profile links to its /tracez trace
 
 Stdlib-only (ThreadingHTTPServer), read-only, zero coupling into the
 control plane beyond the objects it snapshots.  Enabled with
@@ -28,6 +36,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from elasticdl_tpu.utils import slo as slo_mod
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.prom import (  # noqa: F401  (re-exported API)
@@ -62,6 +71,12 @@ def collect_status(task_manager, worker_manager=None,
             # fused-window stats piggybacked on the coalesced progress
             # RPCs — the resize-controller sensor input (ROADMAP 5).
             status["telemetry"] = telemetry
+        rpc_hists = servicer.rpc_histograms()
+        if rpc_hists:
+            # Master RPC handle-time histograms (get_task / progress /
+            # result reports) — rendered as native Prometheus
+            # histograms by utils/prom.py.
+            status["rpc_hists"] = rpc_hists
         ps_state = servicer.ps_state()
         if ps_state:
             # PS recovery plane (docs/ps_recovery.md): per-shard
@@ -71,6 +86,9 @@ def collect_status(task_manager, worker_manager=None,
                 "shards": ps_state,
                 "commit_mark": servicer.ps_commit_mark(),
             }
+    slo = slo_mod.slo_section()
+    if slo is not None:
+        status["slo"] = slo
     return status
 
 
@@ -102,6 +120,18 @@ class HttpStatusServer:
                     # be traced.
                     return self._reply(
                         200, tracing.tracez_body(self.path),
+                        "application/json")
+                if slo_mod.is_alertz_path(self.path):
+                    # The SLO watchdog surface — also independent of
+                    # collect_fn (evaluation reads its own sources).
+                    return self._reply(
+                        200, slo_mod.alertz_body(),
+                        "application/json")
+                if tracing.is_profilez_path(self.path):
+                    # On-demand jax profiler capture; blocks THIS
+                    # request thread for the capture window only.
+                    return self._reply(
+                        200, tracing.profilez_body(self.path),
                         "application/json")
                 try:
                     status = collect_fn()
@@ -176,6 +206,9 @@ def collect_multitenant_status(registry, worker_manager=None):
         status["workers"] = {
             "live": sorted(worker_manager.live_worker_ids()),
         }
+    slo = slo_mod.slo_section()
+    if slo is not None:
+        status["slo"] = slo
     return status
 
 

@@ -186,10 +186,11 @@ class ParameterServer:
             from elasticdl_tpu.master.status_server import (
                 HttpStatusServer,
             )
-            from elasticdl_tpu.utils.prom import prometheus_line
+            from elasticdl_tpu.utils.prom import ps_to_prometheus
+            from elasticdl_tpu.utils.slo import slo_section
 
             def collect():
-                return {
+                status = {
                     "ps_id": self.args.ps_id,
                     "num_ps": self.args.num_ps,
                     "version": self.parameters.version,
@@ -197,27 +198,19 @@ class ParameterServer:
                     "durable_version": self.servicer.durable_version,
                     "initialized": self.parameters.initialized,
                     "counters": dict(self.servicer.counters),
+                    # Push/pull handle-time histograms: rendered
+                    # natively by utils/prom.ps_to_prometheus (the one
+                    # renderer home — the inline renderer that used to
+                    # live here moved there with them).
+                    "hists": self.servicer.timing.histograms(),
                 }
+                slo = slo_section()
+                if slo is not None:
+                    status["slo"] = slo
+                return status
 
-            def prom(status):
-                lines = [
-                    prometheus_line("elasticdl_ps_version",
-                                    status["version"]),
-                    prometheus_line("elasticdl_ps_generation",
-                                    status["generation"]),
-                    prometheus_line("elasticdl_ps_durable_version",
-                                    status["durable_version"]),
-                    prometheus_line("elasticdl_ps_initialized",
-                                    int(status["initialized"])),
-                ] + [
-                    prometheus_line("elasticdl_ps_requests", count,
-                                    kind=kind)
-                    for kind, count in sorted(
-                        status["counters"].items())
-                ]
-                return "\n".join(lines) + "\n"
-
-            self._status_server = HttpStatusServer(collect, prom,
+            self._status_server = HttpStatusServer(collect,
+                                                   ps_to_prometheus,
                                                    port=self.args.
                                                    status_port)
             self._status_server.start()
@@ -292,6 +285,13 @@ def main(argv=None):
         )
     ps = ParameterServer(args, master_client=master_client)
     ps.prepare()
+    # Operator SLO rules from the environment (ELASTICDL_SLO_SPEC,
+    # e.g. "p99(ps.push_handle) < 0.02") resolve against the
+    # servicer's handle-time histograms.
+    from elasticdl_tpu.utils import slo as slo_mod
+
+    slo_mod.default_watchdog().bind_timing(ps.servicer.timing)
+    slo_mod.default_watchdog().arm_from_env()
     signal.signal(signal.SIGTERM, lambda *a: ps.stop(checkpoint=True))
     # AFTER the graceful-checkpoint hook: SIGTERM dumps the flight
     # recorder first, then runs the checkpoint-and-stop chain.

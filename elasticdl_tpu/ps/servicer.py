@@ -33,6 +33,7 @@ from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils import tensor_codec, tracing
 from elasticdl_tpu.utils.grpc_utils import rpc_error_guard
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.timing import Timing
 
 logger = get_logger(__name__)
 
@@ -85,6 +86,12 @@ class PserverServicer:
                          "push_gen_rejected": 0, "ps_ckpt_failed": 0,
                          "pull_dense": 0, "pull_embedding": 0,
                          "pull_embedding_ro": 0}
+        # Handle-time histograms for the data-plane RPCs (push/pull),
+        # rendered as native Prometheus histograms on the shard's
+        # /metrics (utils/prom.ps_to_prometheus).  Durations use local
+        # starts + observe — these RPCs fan out on the 64-thread gRPC
+        # server, so the shared timeit starts dict would corrupt.
+        self.timing = Timing()
 
     # -- RPCs ---------------------------------------------------------------
 
@@ -108,6 +115,14 @@ class PserverServicer:
 
     @rpc_error_guard
     def pull_dense_parameters(self, request, _context=None):
+        t0 = time.perf_counter()
+        try:
+            return self._pull_dense_parameters(request)
+        finally:
+            self.timing.observe("ps.pull_dense",
+                                time.perf_counter() - t0)
+
+    def _pull_dense_parameters(self, request):
         res = pb.PullDenseParametersResponse()
         res.generation = self.generation
         # A client that last observed a different incarnation gets the
@@ -138,6 +153,14 @@ class PserverServicer:
 
     @rpc_error_guard
     def pull_embedding_vectors(self, request, _context=None):
+        t0 = time.perf_counter()
+        try:
+            return self._pull_embedding_vectors(request)
+        finally:
+            self.timing.observe("ps.pull_embedding",
+                                time.perf_counter() - t0)
+
+    def _pull_embedding_vectors(self, request):
         # No servicer lock: the native table's rw-lock (kernels.cc)
         # makes each ROW read/write atomic, so embedding traffic from
         # many workers no longer serializes behind dense updates — this
@@ -206,6 +229,14 @@ class PserverServicer:
 
     @rpc_error_guard
     def push_gradients(self, request, _context=None):
+        t0 = time.perf_counter()
+        try:
+            return self._push_gradients(request)
+        finally:
+            self.timing.observe("ps.push_handle",
+                                time.perf_counter() - t0)
+
+    def _push_gradients(self, request):
         fenced = self._fence(request.generation)
         if fenced is not None:
             return fenced

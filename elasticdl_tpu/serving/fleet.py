@@ -127,6 +127,7 @@ class _Replica:
         "occupancy", "queue_wait_ms", "inflight", "failures",
         "next_probe_at", "ever_probed",
         "qw_count", "qw_sum_s", "queue_wait_recent_ms",
+        "queue_wait_diff_ms",
     )
 
     def __init__(self, addr):
@@ -140,20 +141,25 @@ class _Replica:
         self.failures = 0         # consecutive probe/forward failures
         self.next_probe_at = 0.0  # due immediately
         self.ever_probed = False
-        # Windowed queue-wait (the autoscaler's load signal): /statz
-        # reports a LIFETIME mean, useless for reactive decisions —
-        # differencing (count, sum) between successive probes yields
-        # the mean over just the last probe interval.
+        # Windowed queue-wait (the autoscaler's load signal).  The
+        # replica now reports its OWN windowed value on /statz
+        # (``queue_wait_recent_ms``, straight from its histogram);
+        # probe differencing of the cumulative (count, sum) remains as
+        # a CROSS-CHECK (``queue_wait_diff_ms``) and as the fallback
+        # toward replicas predating the field.
         self.qw_count = 0
         self.qw_sum_s = 0.0
         self.queue_wait_recent_ms = None
+        self.queue_wait_diff_ms = None
 
 
 def _statz_view(statz):
-    """(serving_version, occupancy, queue_wait_ms, draining) out of a
-    replica's /statz payload.  Multi-model replicas report the MINIMUM
-    serving version — the fleet barrier must hold for every model the
-    replica hosts."""
+    """(serving_version, occupancy, queue_wait_ms, recent_ms,
+    draining) out of a replica's /statz payload.  Multi-model replicas
+    report the MINIMUM serving version — the fleet barrier must hold
+    for every model the replica hosts.  ``recent_ms`` is the replica's
+    OWN windowed queue wait (histogram-backed, serving/server.py
+    stats()); None from replicas predating the field."""
     models = statz.get("models", {})
     version = min(
         (int(stats.get("version", 0) or 0)
@@ -162,13 +168,18 @@ def _statz_view(statz):
     )
     occupancy = None
     queue_wait_ms = None
+    recent_ms = None
     for stats in models.values():
         if stats.get("mean_batch_occupancy") is not None:
             occupancy = stats["mean_batch_occupancy"]
         wait = stats.get("timing", {}).get("batcher.queue_wait")
         if wait and wait.get("count"):
             queue_wait_ms = 1e3 * wait["mean_s"]
-    return version, occupancy, queue_wait_ms, bool(
+        if stats.get("queue_wait_recent_ms") is not None:
+            recent_ms = (max(recent_ms, stats["queue_wait_recent_ms"])
+                         if recent_ms is not None
+                         else stats["queue_wait_recent_ms"])
+    return version, occupancy, queue_wait_ms, recent_ms, bool(
         statz.get("draining"))
 
 
@@ -215,8 +226,8 @@ class FleetState:
                     if r.next_probe_at <= now]
 
     def note_probe_ok(self, addr, statz, now):
-        version, occupancy, queue_wait_ms, draining = _statz_view(
-            statz)
+        (version, occupancy, queue_wait_ms, recent_ms,
+         draining) = _statz_view(statz)
         qw_count, qw_sum_s = _statz_queue_totals(statz)
         with self._lock:
             r = self._replicas.get(addr)
@@ -230,17 +241,24 @@ class FleetState:
             r.occupancy = occupancy
             r.queue_wait_ms = queue_wait_ms
             if qw_count > r.qw_count:
-                r.queue_wait_recent_ms = (
+                r.queue_wait_diff_ms = (
                     1e3 * (qw_sum_s - r.qw_sum_s)
                     / (qw_count - r.qw_count))
             elif qw_count < r.qw_count:
                 # Replica restarted on the same port: counters reset.
-                r.queue_wait_recent_ms = None
+                r.queue_wait_diff_ms = None
             else:
                 # No traffic this interval — an idle replica has zero
                 # recent queue wait by definition.
-                r.queue_wait_recent_ms = 0.0
+                r.queue_wait_diff_ms = 0.0
             r.qw_count, r.qw_sum_s = qw_count, qw_sum_s
+            # The EFFECTIVE recent-load signal (autoscaler input):
+            # the replica's own histogram-windowed report when
+            # present, probe differencing as the fallback — and the
+            # differenced value stays visible as a cross-check.
+            r.queue_wait_recent_ms = (
+                recent_ms if recent_ms is not None
+                else r.queue_wait_diff_ms)
             r.failures = 0
             r.next_probe_at = now + self.probe_interval
         if came_back:
@@ -424,6 +442,7 @@ class FleetState:
                     "occupancy": r.occupancy,
                     "queue_wait_ms": r.queue_wait_ms,
                     "queue_wait_recent_ms": r.queue_wait_recent_ms,
+                    "queue_wait_diff_ms": r.queue_wait_diff_ms,
                     "inflight": r.inflight,
                     "failures": r.failures,
                 }

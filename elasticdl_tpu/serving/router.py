@@ -56,8 +56,10 @@ from elasticdl_tpu.serving.fleet import (
     pick_replica,
     rendezvous_rank,
 )
+from elasticdl_tpu.utils import slo as slo_mod
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import build_router_parser
+from elasticdl_tpu.utils.hist import Histogram
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.prom import fleet_to_prometheus
 
@@ -229,6 +231,16 @@ class Router:
                              "errors": 0, "latency_ms_sum": 0.0,
                              "model_version": 0}
                          for c in ("baseline", "canary")}
+        # Latency DISTRIBUTIONS (utils/hist.py; /metrics renders them
+        # as native Prometheus histograms): per cohort — the promote/
+        # rollback evidence as a real p99 — and per replica (the
+        # router-side tail view of each backend).  Histograms carry
+        # their own locks; the dict of per-replica ones gets a plain
+        # guard (request threads mint entries on first forward).
+        self._cohort_lat = {c: Histogram()
+                            for c in ("baseline", "canary")}
+        self._replica_lat_lock = threading.Lock()
+        self._replica_lat = {}
         # Last aggregation-tier report (freshness SLO telemetry),
         # attached by /fleet/rollout / /fleet/canary posts.
         self._agg = None
@@ -521,6 +533,13 @@ class Router:
         pool = self._pools.pop(addr, None)
         if pool is not None:
             pool.clear()
+        # Retire its latency histogram too: over autoscaler churn the
+        # dict (and the /metrics payload) would otherwise grow one
+        # full histogram block per EVER-seen replica address, exporting
+        # long-dead replicas forever — the stale-series class the
+        # worker-telemetry eviction already kills on the master.
+        with self._replica_lat_lock:
+            self._replica_lat.pop(addr, None)
 
     # -- routing -------------------------------------------------------
 
@@ -590,11 +609,19 @@ class Router:
             status, body, content_type, addr = self._forward_pool(
                 method, path, raw_body, key, self.committed_view,
                 exclude_members=addrs)
+        elapsed = time.monotonic() - start
         self._note_cohort(
             cohort, keyed=key is not None,
-            latency_ms=1e3 * (time.monotonic() - start),
+            latency_ms=1e3 * elapsed,
             error=status >= 500,
             version=version_pin())
+        self._cohort_lat[cohort].observe(elapsed)
+        if addr is not None:
+            with self._replica_lat_lock:
+                h = self._replica_lat.get(addr)
+                if h is None:
+                    h = self._replica_lat[addr] = Histogram()
+            h.observe(elapsed)
         return status, body, content_type, addr
 
     def _note_cohort(self, cohort, keyed, latency_ms, error, version):
@@ -611,8 +638,13 @@ class Router:
 
     def cohort_stats(self):
         with self._cohort_lock:
-            return {name: dict(c)
-                    for name, c in self._cohorts.items()}
+            out = {name: dict(c)
+                   for name, c in self._cohorts.items()}
+        for name, h in self._cohort_lat.items():
+            snap = h.snapshot()
+            if snap["count"]:
+                out[name]["latency_hist"] = snap
+        return out
 
     def _forward_pool(self, method, path, raw_body, key, version_pin,
                       members=None, exclude_members=()):
@@ -708,9 +740,17 @@ class Router:
 
     # -- observability -------------------------------------------------
 
+    def latency_hists(self):
+        """{replica addr: latency histogram snapshot} for replicas
+        that have taken traffic."""
+        with self._replica_lat_lock:
+            hists = dict(self._replica_lat)
+        return {addr: h.snapshot() for addr, h in hists.items()}
+
     def fleet_status(self):
         replicas, counters = self.state.snapshot()
         canary = self._canary
+        status_slo = slo_mod.slo_section()
         return {
             "committed_version": self.coordinator.committed_version,
             "coordinating": self.coordinating,
@@ -725,7 +765,9 @@ class Router:
                 "replicas": sorted(canary[2]) if canary else [],
                 "cohorts": self.cohort_stats(),
             },
+            "latency_hists": self.latency_hists(),
             "aggregation": self._agg,
+            **({"slo": status_slo} if status_slo is not None else {}),
         }
 
 
@@ -770,6 +812,12 @@ def build_router_server(router, port=0, host="127.0.0.1",
                 # failovers — same query API as every other tier.
                 return self._reply_raw(
                     200, tracing.tracez_body(self.path).encode(),
+                    "application/json")
+            if slo_mod.is_alertz_path(self.path):
+                # SLO watchdog surface (utils/slo.py) — same API as
+                # every other tier's /alertz.
+                return self._reply_raw(
+                    200, slo_mod.alertz_body().encode(),
                     "application/json")
             if self.path.startswith("/v1/"):
                 status, body, content_type, _ = router.forward(
@@ -885,6 +933,14 @@ def main(argv=None):
             idle_secs=args.idle_secs,
             cooldown_secs=args.autoscale_cooldown_secs,
         )
+    # SLO rules from the environment (ELASTICDL_SLO_SPEC): cohort
+    # latency distributions are the natural sources here, e.g.
+    # "p99(cohort_latency) < 0.25" over the baseline cohort.
+    wd = slo_mod.default_watchdog()
+    wd.add_source(
+        "cohort_latency",
+        lambda: router._cohort_lat["baseline"].snapshot())
+    wd.arm_from_env()
     server = build_router_server(router, port=args.port,
                                  host=args.host)
     router.start()

@@ -68,6 +68,7 @@ from elasticdl_tpu.serving.loader import (
     load_servable,
     resolve_export_dir,
 )
+from elasticdl_tpu.utils import slo as slo_mod
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import build_serving_parser
 from elasticdl_tpu.utils.logging import get_logger
@@ -450,9 +451,18 @@ class ModelEndpoint:
             "metadata": model.manifest,
         }
 
+    # Window for the replica-reported recent queue wait (see stats():
+    # one probe interval's worth of "how loaded am I right now").
+    RECENT_WINDOW_SECS = 2.0
+
     def stats(self):
         """/statz payload: live version, batching config, Timing
-        counters (batch occupancy, queue wait, execution time)."""
+        counters (batch occupancy, queue wait, execution time), the
+        queue-wait/execute HISTOGRAMS (native Prometheus rendering +
+        p99 for anyone reading /statz raw), and the windowed
+        ``queue_wait_recent_ms`` — the replica's OWN recent-load
+        signal, so the router/autoscaler's probe-differencing becomes
+        a cross-check instead of the only recent series."""
         model = self._snapshot()[0]
         counters = self.timing.counters()
         batches = counters.get("batcher.batches", 0)
@@ -466,7 +476,16 @@ class ModelEndpoint:
             "mean_batch_occupancy": (
                 counters.get("batcher.rows", 0) / batches
                 if batches else None),
+            "hists": self.timing.histograms(
+                names=("batcher.queue_wait", "batcher.execute")),
         }
+        recent = self.timing.recent("batcher.queue_wait",
+                                    self.RECENT_WINDOW_SECS)
+        if recent is not None and recent["count"] > 0:
+            out["queue_wait_recent_ms"] = (
+                1e3 * recent["sum"] / recent["count"])
+        elif recent is not None:
+            out["queue_wait_recent_ms"] = 0.0
         if self._embedding_service is not None:
             out["emb_cache"] = self._embedding_service.stats()
         return out
@@ -676,11 +695,15 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
             self.wfile.write(body)
 
         def _statz(self):
-            return {
+            out = {
                 "draining": drain.draining,
                 "models": {name: endpoint.stats()
                            for name, endpoint in by_name.items()},
             }
+            slo = slo_mod.slo_section()
+            if slo is not None:
+                out["slo"] = slo
+            return out
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -710,6 +733,17 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
                 # as every other tier's /tracez.
                 return self._reply_text(
                     200, tracing.tracez_body(self.path),
+                    "application/json")
+            if slo_mod.is_alertz_path(self.path):
+                # The SLO watchdog surface (utils/slo.py), same API
+                # as every other tier's /alertz.
+                return self._reply_text(
+                    200, slo_mod.alertz_body(), "application/json")
+            if tracing.is_profilez_path(self.path):
+                # On-demand jax.profiler capture; blocks this request
+                # thread only (the executor keeps serving).
+                return self._reply_text(
+                    200, tracing.profilez_body(self.path),
                     "application/json")
             if self.path == "/fleet/state":
                 return self._reply(200, {
@@ -871,6 +905,12 @@ def main(argv=None):
         endpoints = [ModelEndpoint(args.export_dir,
                                    name=args.model_name, **kwargs())]
     server = build_server(endpoints, port=args.port, host=args.host)
+    # SLO rules from the environment (ELASTICDL_SLO_SPEC, e.g.
+    # "p99(batcher.queue_wait) < 0.05"): pXX()/mean() phases resolve
+    # against the first endpoint's Timing (the single-model common
+    # case; multi-model processes name sources explicitly in code).
+    slo_mod.default_watchdog().bind_timing(endpoints[0].timing)
+    slo_mod.default_watchdog().arm_from_env()
     install_drain_handler(server, endpoints, server.drain,
                           grace_secs=args.drain_grace_secs)
     # AFTER the drain hook: SIGTERM dumps the flight recorder, then

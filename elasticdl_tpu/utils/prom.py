@@ -12,7 +12,18 @@ Escaping per the exposition format spec: label values escape
 backslash, double-quote, and newline.  Metric names and label names
 are caller-controlled identifiers and are NOT escaped — a bad name is
 a bug, not data.
+
+Latency series render as NATIVE Prometheus histograms
+(``histogram_lines``: ``_bucket{le=}``/``_sum``/``_count`` over the
+fixed utils/hist.py boundary set), so a standard scraper derives p99
+with ``histogram_quantile()`` on every surface — no lifetime means.
+
+Every ``elasticdl_*`` series name emitted here (or anywhere) must be
+declared in ``utils/metric_registry.py`` — elastic-lint EL010 fails
+on a typo'd or undocumented series.
 """
+
+from elasticdl_tpu.utils.hist import BUCKET_BOUNDS
 
 
 def escape_label_value(value):
@@ -33,6 +44,47 @@ def prometheus_line(metric, value, **labels):
             for name, val in sorted(labels.items())
         )
     return "%s%s %s" % (metric, label_str, value)
+
+
+def _format_bound(bound):
+    """Shortest exact-ish decimal for a ``le`` label value."""
+    return "%.10g" % bound
+
+
+def histogram_lines(lines, metric, snap, **labels):
+    """Render one utils/hist.py snapshot as a native Prometheus
+    histogram: cumulative ``<metric>_bucket{le=...}`` rows over the
+    shared boundary set, the mandatory ``le="+Inf"`` row equal to
+    ``<metric>_count``, plus ``<metric>_sum``.  Values are SECONDS
+    (the Prometheus base-unit convention) — callers converting from
+    ms scale before snapshotting, not here."""
+    if not snap:
+        return
+    cumulative = 0
+    for bound, count in zip(BUCKET_BOUNDS, snap["counts"]):
+        cumulative += count
+        lines.append(prometheus_line(
+            "%s_bucket" % metric, cumulative,
+            le=_format_bound(bound), **labels))
+    lines.append(prometheus_line(
+        "%s_bucket" % metric, snap["count"], le="+Inf", **labels))
+    lines.append(prometheus_line(
+        "%s_sum" % metric, "%.9g" % snap["sum"], **labels))
+    lines.append(prometheus_line(
+        "%s_count" % metric, snap["count"], **labels))
+
+
+def _slo_gauges(lines, slo):
+    """The SLO watchdog's /metrics rows (utils/slo.py payload shape):
+    per-rule ok gauge + breach-episode counter — shared by every
+    renderer so alerting reads one format across tiers."""
+    for rule, r in sorted((slo or {}).get("rules", {}).items()):
+        labels = {"rule": rule}
+        lines.append(prometheus_line(
+            "elasticdl_slo_ok", int(bool(r.get("ok", True))), **labels))
+        lines.append(prometheus_line(
+            "elasticdl_slo_breach_total", r.get("breach_total", 0),
+            **labels))
 
 
 def _task_gauges(lines, tasks, finished, **labels):
@@ -94,6 +146,24 @@ def _telemetry_gauges(lines, telemetry, **labels):
         lines.append(prometheus_line(
             "elasticdl_worker_steps_done",
             t.get("steps_done", 0), **wl))
+        # Straggler plane (docs/observability.md): the sustained
+        # cross-worker skew flag plus the recent per-worker p50 the
+        # detector judged on.
+        if t.get("straggler") is not None:
+            lines.append(prometheus_line(
+                "elasticdl_worker_straggler",
+                int(bool(t["straggler"])), **wl))
+        if t.get("step_p50_ms") is not None:
+            lines.append(prometheus_line(
+                "elasticdl_worker_step_p50_seconds",
+                round(t["step_p50_ms"] / 1e3, 6), **wl))
+    if job.get("step_hist"):
+        # TRUE per-job step-time distribution: exact merge of the
+        # per-worker histogram deltas piggybacked on progress RPCs —
+        # a scraper's histogram_quantile() here is a real p99, not a
+        # mean of worker means.
+        histogram_lines(lines, "elasticdl_job_step_time_seconds",
+                        job["step_hist"], **labels)
 
 
 def to_prometheus(status):
@@ -121,6 +191,10 @@ def to_prometheus(status):
             gauge("elasticdl_ps_shard_durable_version",
                   shard["durable_version"], ps_id=str(ps_id))
     _telemetry_gauges(lines, status.get("telemetry"))
+    for method, snap in sorted(status.get("rpc_hists", {}).items()):
+        histogram_lines(lines, "elasticdl_master_rpc_handle_seconds",
+                        snap, method=method)
+    _slo_gauges(lines, status.get("slo"))
     return "\n".join(lines) + "\n"
 
 
@@ -164,6 +238,12 @@ def multitenant_to_prometheus(status):
                   len(jstatus["rendezvous"]["world"]), **labels)
     if "workers" in status:
         gauge("elasticdl_workers_live", len(status["workers"]["live"]))
+    for phase, snap in sorted(sched.get("hists", {}).items()):
+        # Scheduler decision latency (ResizeController tick / rebalance
+        # phases) as native histograms.
+        histogram_lines(lines, "elasticdl_sched_decision_seconds",
+                        snap, phase=phase)
+    _slo_gauges(lines, status.get("slo"))
     return "\n".join(lines) + "\n"
 
 
@@ -192,6 +272,22 @@ def serving_to_prometheus(status):
         if wait:
             gauge("elasticdl_serving_queue_wait_ms",
                   1e3 * wait["mean_s"])
+        if stats.get("queue_wait_recent_ms") is not None:
+            # Windowed recent queue wait straight from the replica's
+            # own histogram (utils/hist.recent) — the router's probe
+            # differencing is now a cross-check, not the only recent
+            # signal.
+            gauge("elasticdl_serving_queue_wait_recent_ms",
+                  round(stats["queue_wait_recent_ms"], 3))
+        hists = stats.get("hists", {})
+        for phase, metric in (
+                ("batcher.queue_wait",
+                 "elasticdl_serving_queue_wait_seconds"),
+                ("batcher.execute",
+                 "elasticdl_serving_execute_seconds")):
+            if hists.get(phase):
+                histogram_lines(lines, metric, hists[phase],
+                                model=name)
         cache = stats.get("emb_cache")
         if cache:
             gauge("elasticdl_serving_emb_cache_bytes", cache["bytes"])
@@ -201,6 +297,7 @@ def serving_to_prometheus(status):
             if cache.get("hit_ratio") is not None:
                 gauge("elasticdl_serving_emb_cache_hit_ratio",
                       round(cache["hit_ratio"], 6))
+    _slo_gauges(lines, status.get("slo"))
     return "\n".join(lines) + "\n"
 
 
@@ -233,6 +330,15 @@ def fleet_to_prometheus(status):
         if rep.get("queue_wait_ms") is not None:
             gauge("elasticdl_fleet_replica_queue_wait_ms",
                   rep["queue_wait_ms"])
+        if rep.get("queue_wait_recent_ms") is not None:
+            gauge("elasticdl_fleet_replica_queue_wait_recent_ms",
+                  round(rep["queue_wait_recent_ms"], 3))
+    for addr, snap in sorted(
+            (status.get("latency_hists") or {}).items()):
+        # Per-replica end-to-end forward latency as a native
+        # histogram — the router-side view of each replica's tail.
+        histogram_lines(lines, "elasticdl_fleet_replica_latency_seconds",
+                        snap, replica=addr)
     for name, value in sorted(status.get("counters", {}).items()):
         lines.append(prometheus_line("elasticdl_fleet_router_counter",
                                      value, name=name))
@@ -263,6 +369,12 @@ def fleet_to_prometheus(status):
                         / c["requests"], 3))
         gauge("elasticdl_fleet_canary_model_version",
               c.get("model_version", 0))
+        if c.get("latency_hist"):
+            # Per-cohort latency distribution: the promote-or-rollback
+            # evidence as a real p99, not a mean.
+            histogram_lines(lines,
+                            "elasticdl_fleet_cohort_latency_seconds",
+                            c["latency_hist"], cohort=cohort)
     agg = status.get("aggregation") or {}
     if agg.get("freshness_seconds") is not None:
         # The aggregation tier's publish-freshness SLO telemetry
@@ -274,4 +386,33 @@ def fleet_to_prometheus(status):
                                            3)))
         lines.append(prometheus_line(
             "elasticdl_agg_published_version", agg.get("version", 0)))
+    _slo_gauges(lines, status.get("slo"))
+    return "\n".join(lines) + "\n"
+
+
+def ps_to_prometheus(status):
+    """PS-shard /metrics renderer (ps/server.py status server):
+    version/generation/durable gauges, request counters, and the
+    push/pull handle-time histograms (docs/observability.md)."""
+    lines = [
+        prometheus_line("elasticdl_ps_version", status["version"]),
+        prometheus_line("elasticdl_ps_generation",
+                        status["generation"]),
+        prometheus_line("elasticdl_ps_durable_version",
+                        status["durable_version"]),
+        prometheus_line("elasticdl_ps_initialized",
+                        int(status["initialized"])),
+    ] + [
+        prometheus_line("elasticdl_ps_requests", count, kind=kind)
+        for kind, count in sorted(status["counters"].items())
+    ]
+    for phase, metric in (
+            ("ps.push_handle", "elasticdl_ps_push_handle_seconds"),
+            ("ps.pull_dense", "elasticdl_ps_pull_dense_seconds"),
+            ("ps.pull_embedding",
+             "elasticdl_ps_pull_embedding_seconds")):
+        snap = status.get("hists", {}).get(phase)
+        if snap:
+            histogram_lines(lines, metric, snap)
+    _slo_gauges(lines, status.get("slo"))
     return "\n".join(lines) + "\n"

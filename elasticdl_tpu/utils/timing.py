@@ -23,9 +23,18 @@ import threading
 import time
 from collections import defaultdict
 
+from elasticdl_tpu.utils import hist as hist_mod
+
 
 class Timing:
-    """Accumulates wall-clock per named phase across calls."""
+    """Accumulates wall-clock per named phase across calls.
+
+    Behind every phase's (total, count) mean sits a streaming
+    log-bucketed histogram (utils/hist.py) fed by the same
+    ``observe``/``end`` calls, so any phase has a derivable p50/p99
+    and a windowed recent view — globally switchable via
+    ``hist.set_enabled`` / ``ELASTICDL_HIST=off`` (bench overhead
+    legs)."""
 
     def __init__(self, enabled=True, logger=None):
         self._enabled = enabled
@@ -39,6 +48,8 @@ class Timing:
             self._counts = defaultdict(int)
             self._starts = {}
             self._events = defaultdict(int)
+            self._hists = {}
+
 
     def bump(self, name, n=1):
         """Count a discrete event (no duration) — e.g. how often an
@@ -52,15 +63,27 @@ class Timing:
         with self._lock:
             return dict(self._events)
 
-    def observe(self, name, seconds):
-        """Record one already-measured duration — for phases whose
-        start and end happen on different threads (e.g. a serving
-        request's queue wait: enqueued on the request thread, measured
-        when the batcher executor picks it up)."""
+    def observe(self, name, seconds, n=1):
+        """Record ``n`` already-measured durations of ``seconds`` each
+        — for phases whose start and end happen on different threads
+        (e.g. a serving request's queue wait: enqueued on the request
+        thread, measured when the batcher executor picks it up).  The
+        bulk form (n > 1) is for per-step stats derived once per fused
+        window."""
         if self._enabled:
+            h = None
             with self._lock:
-                self._totals[name] += seconds
-                self._counts[name] += 1
+                self._totals[name] += seconds * n
+                self._counts[name] += n
+                if hist_mod.hist_enabled():
+                    # Get-or-create under the Timing lock (dict
+                    # mutation); the observe itself runs on the
+                    # histogram's own leaf lock OUTSIDE this one.
+                    h = self._hists.get(name)
+                    if h is None:
+                        h = self._hists[name] = hist_mod.Histogram()
+            if h is not None:
+                h.observe(seconds, n=n)
 
     def start(self, name):
         if self._enabled:
@@ -71,10 +94,19 @@ class Timing:
     def end(self, name):
         if self._enabled:
             now = time.perf_counter()
+            h = seconds = None
             with self._lock:
                 if name in self._starts:
-                    self._totals[name] += now - self._starts.pop(name)
+                    seconds = now - self._starts.pop(name)
+                    self._totals[name] += seconds
                     self._counts[name] += 1
+                    if hist_mod.hist_enabled():
+                        h = self._hists.get(name)
+                        if h is None:
+                            h = self._hists[name] = (
+                                hist_mod.Histogram())
+            if h is not None:
+                h.observe(seconds)
 
     @contextlib.contextmanager
     def timeit(self, name):
@@ -83,6 +115,38 @@ class Timing:
             yield
         finally:
             self.end(name)
+
+    # -- histogram readers (the percentile plane) ---------------------------
+
+    def histograms(self, names=None):
+        """{phase: snapshot dict} for every phase with a histogram
+        (or only ``names``) — the shape utils/prom.py renders as
+        native Prometheus histograms and /statz ships raw."""
+        with self._lock:
+            hists = {
+                name: h for name, h in self._hists.items()
+                if names is None or name in names
+            }
+        return {name: h.snapshot() for name, h in hists.items()}
+
+    def hist_snapshot(self, name):
+        with self._lock:
+            h = self._hists.get(name)
+        return h.snapshot() if h is not None else None
+
+    def percentile(self, name, q):
+        """qth quantile estimate for a phase (seconds), or None."""
+        snap = self.hist_snapshot(name)
+        return hist_mod.quantile(snap, q) if snap else None
+
+    def recent(self, name, window_secs=5.0, now=None):
+        """Delta snapshot over roughly the last ``window_secs`` for a
+        phase (see hist.Histogram.recent), or None — the direct
+        windowed-load signal /statz surfaces so consumers stop
+        re-deriving it by probe-differencing."""
+        with self._lock:
+            h = self._hists.get(name)
+        return h.recent(window_secs, now=now) if h is not None else None
 
     def sync_fraction(self, dispatch_name, sync_name):
         """Blocked-on-device share of an async hot loop: with the fused

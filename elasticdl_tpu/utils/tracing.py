@@ -382,7 +382,15 @@ def arm_crash_dump(trace_dir=None, tracer=None):
     worker's graceful-preemption hook, still runs).  Call AFTER the
     process installed its own SIGTERM handler so the chain includes
     it.  No-op without a trace dir (flag or $ELASTICDL_TRACE_DIR) —
-    the ring then stays memory-only, queryable via /tracez."""
+    the ring then stays memory-only, queryable via /tracez.
+
+    Also arms SIGQUIT as a LIVE dump: ``kill -QUIT <pid>`` writes the
+    ring to the trace dir and the process keeps running — the
+    inspect-a-wedged-process path (a /tracez scrape needs a live HTTP
+    thread; SIGQUIT needs only the signal machinery).  Chain-safe like
+    the SIGTERM hook, except the default disposition (core dump) is
+    deliberately NOT re-delivered — replacing "core dump" with "dump
+    the ring and live" is the feature."""
     tracer = tracer or _TRACER
     trace_dir = trace_dir or os.environ.get(ENV_TRACE_DIR)
     if not trace_dir or _armed["done"] or not tracer.enabled:
@@ -427,6 +435,35 @@ def arm_crash_dump(trace_dir=None, tracer=None):
         signal.signal(signal.SIGTERM, on_term)
     except ValueError:
         pass  # not the main thread (embedded use): atexit still dumps
+
+    try:
+        prev_quit = signal.getsignal(signal.SIGQUIT)
+
+        def on_quit(signum, frame):
+            # The event+dump run OFF the signal frame: the handler
+            # fires on the main thread between bytecodes — possibly
+            # while the interrupted frame HOLDS the recorder lock
+            # (record()/snapshot() are everywhere on the main loop) —
+            # and both calls acquire that non-reentrant lock.  Dumping
+            # inline would deadlock the very process this handler
+            # exists to inspect alive; a daemon thread waits for the
+            # interrupted frame to release it instead.
+            def _quit_dump():
+                tracer.event("sigquit")
+                _dump()
+
+            threading.Thread(target=_quit_dump, daemon=True,
+                             name="sigquit-dump").start()
+            if callable(prev_quit):
+                # A process that installed its own SIGQUIT semantics
+                # keeps them; we only prepend the dump.
+                prev_quit(signum, frame)
+            # SIG_DFL (core dump) / SIG_IGN: swallowed — the live-
+            # inspection contract is "dump and keep running".
+
+        signal.signal(signal.SIGQUIT, on_quit)
+    except (ValueError, AttributeError):
+        pass  # non-main thread, or a platform without SIGQUIT
     return trace_dir
 
 
@@ -588,3 +625,83 @@ def tracez_body(path, tracer=None):
 
 def is_tracez_path(path):
     return path.split("?", 1)[0] == "/tracez"
+
+
+# -- /profilez: jax.profiler capture on demand --------------------------------
+
+# One capture at a time per process (jax.profiler is a process-global
+# singleton); the flag flip is the only thing under the lock — the
+# capture itself (a sleep) runs outside every lock.
+_PROFILE_MAX_SECS = 60.0
+_profile_lock = threading.Lock()
+_profile_state = {"active": False, "captures": 0}
+
+
+def profilez_capture(secs, trace_dir=None, profiler=None,
+                     tracer=None):
+    """Capture a device/host profile for ``secs`` seconds into the
+    trace dir; returns a JSON-able result dict.  The capture directory
+    and the current trace id are stamped on a ``profile.capture``
+    flight-recorder event, so a Perfetto profile links back to the
+    /tracez trace that requested it (docs/observability.md).
+
+    ``profiler`` defaults to ``jax.profiler`` (injected by tests); a
+    missing/failing backend returns an error dict, never raises — this
+    runs on status-server request threads."""
+    tracer = tracer or _TRACER
+    secs = max(0.0, min(float(secs), _PROFILE_MAX_SECS))
+    with _profile_lock:
+        if _profile_state["active"]:
+            return {"ok": False,
+                    "error": "a profile capture is already running"}
+        _profile_state["active"] = True
+        _profile_state["captures"] += 1
+        n = _profile_state["captures"]
+    try:
+        if profiler is None:
+            import jax
+
+            profiler = jax.profiler
+        base = trace_dir or os.environ.get(ENV_TRACE_DIR) or "/tmp"
+        role = tracer.process_attrs.get("role", "proc")
+        out_dir = os.path.join(
+            base, "profile-%s-%d-%d" % (role, os.getpid(), n))
+        os.makedirs(out_dir, exist_ok=True)
+        trace_id, span_id = tracer.current()
+        tracer.event("profile.capture", dir=out_dir, secs=secs)
+        profiler.start_trace(out_dir)
+        try:
+            time.sleep(secs)
+        finally:
+            profiler.stop_trace()
+        return {"ok": True, "dir": out_dir, "secs": secs,
+                "trace": trace_id,
+                "process": tracer.process_attrs}
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        # observability; a backend without profiler support answers
+        # with the error instead of a dropped connection
+        return {"ok": False, "error": "%s: %s" % (type(e).__name__, e)}
+    finally:
+        with _profile_lock:
+            _profile_state["active"] = False
+
+
+def profilez_body(path, trace_dir=None, profiler=None, tracer=None):
+    """Shared /profilez?secs=N HTTP responder body.  Blocks the
+    calling request thread for the capture duration (ThreadingHTTP
+    servers everywhere — other endpoints keep answering)."""
+    import urllib.parse
+
+    query = urllib.parse.urlparse(path).query
+    raw = urllib.parse.parse_qs(query).get("secs", ["2"])[0]
+    try:
+        secs = float(raw)
+    except ValueError:
+        return json.dumps({"ok": False,
+                           "error": "bad secs=%r" % raw})
+    return json.dumps(profilez_capture(
+        secs, trace_dir=trace_dir, profiler=profiler, tracer=tracer))
+
+
+def is_profilez_path(path):
+    return path.split("?", 1)[0] == "/profilez"

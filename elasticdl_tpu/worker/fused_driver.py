@@ -52,6 +52,7 @@ worker selects it for ``--fused_steps 1`` and for every trainer whose
 ``max_window`` is 1).
 """
 
+import time
 from collections import namedtuple
 
 import numpy as np
@@ -126,6 +127,7 @@ class FusedStepDriver:
         stop_check=None,
         callbacks=(),
         prepare=None,
+        step_throttle_secs=0.0,
     ):
         """``prepare``: optional item -> PreparedBatch hook applied
         INSIDE the loop, after each window's elastic epoch check — the
@@ -151,6 +153,11 @@ class FusedStepDriver:
         self._elastic = elastic
         self._stop_check = stop_check
         self._callbacks = callbacks
+        # Drill knob (worker.step_throttle_secs): deliberate per-step
+        # slowdown so churn drills can stage a straggler on the FUSED
+        # path too — without this the env-armed throttle would be a
+        # silent no-op for any fused-config worker.
+        self._step_throttle = float(step_throttle_secs or 0.0)
         self.loss_ring = LossRing()
 
     @property
@@ -220,7 +227,8 @@ class FusedStepDriver:
         arrays on a cleared backend."""
         if not batches or not self._stage_ahead:
             return None
-        return self._trainer.stage_window(batches, to_device=True)
+        with self._timing.timeit("host_prep"):
+            return self._trainer.stage_window(batches, to_device=True)
 
     def _dispatch(self, cur, staged):
         """Dispatch one window; ``staged`` is the ahead-staged form (or
@@ -255,8 +263,19 @@ class FusedStepDriver:
         """
         trainer, timing = self._trainer, self._timing
         start = steps_done
-        cur = self._collect(batch_iter, self._window_limit(steps_done))
+        with timing.timeit("data_wait"):
+            cur = self._collect(batch_iter,
+                                self._window_limit(steps_done))
         staged = self._stage(cur)
+        # Step-time anatomy (docs/observability.md): each loop pass
+        # below is decomposed into data_wait (producer starvation) /
+        # host_prep (stack + device_put) / window_dispatch (XLA
+        # enqueue) / loss_sync (device fence) / progress_rpc (master
+        # report), each feeding a per-phase histogram via Timing; the
+        # whole pass's wall time over its step count is the honest
+        # per-step step time (windowed dispatch means individual steps
+        # inside one program are not separately observable).
+        t_prev = time.perf_counter()
         while cur:
             if self._elastic is not None:
                 # One epoch check per window, counted as len(cur) steps
@@ -272,13 +291,17 @@ class FusedStepDriver:
                 cur = [self._prepare(item) for item in cur]
             with timing.timeit("window_dispatch"):
                 losses, version = self._dispatch(cur, staged)
+            if self._step_throttle:
+                time.sleep(self._step_throttle * len(cur))
             steps_done += len(cur)
             timing.bump("fused_windows")
             timing.bump("fused_steps_run", len(cur))
             # Collect + stage the NEXT window while the current one is
             # still executing on device: host feed and host→device
             # transfer overlap the running step.
-            nxt = self._collect(batch_iter, self._window_limit(steps_done))
+            with timing.timeit("data_wait"):
+                nxt = self._collect(batch_iter,
+                                    self._window_limit(steps_done))
             staged = self._stage(nxt)
             self.loss_ring.push(steps_done, losses)
             fetched = None
@@ -292,9 +315,18 @@ class FusedStepDriver:
             # per fused window (counts buffered per batch, flushed at
             # the window boundary — and, structurally, at task
             # boundaries inside DataShardService).
-            for batch in cur:
-                self._shard.report_batch_done(batch.count, defer=True)
-            self._shard.flush_batch_done()
+            with timing.timeit("progress_rpc"):
+                for batch in cur:
+                    self._shard.report_batch_done(batch.count,
+                                                  defer=True)
+                self._shard.flush_batch_done()
+            # One bulk observation per window: this pass's wall time
+            # spread over its steps — the step-time distribution the
+            # master aggregates per job (and judges stragglers on).
+            t_now = time.perf_counter()
+            timing.observe("step_time",
+                           (t_now - t_prev) / len(cur), n=len(cur))
+            t_prev = t_now
             if (
                 self._log_loss_steps
                 and steps_done % self._log_loss_steps == 0

@@ -198,6 +198,12 @@ class MasterClient:
         steps_done = (telemetry or {}).get("steps_done")
         if steps_done is not None:
             req.steps_done = int(steps_done)
+        hist_delta = (telemetry or {}).get("hist_delta")
+        if hist_delta:
+            # Sparse step-time histogram delta (utils/hist.py): the
+            # master merges these exactly into per-worker/per-job
+            # distributions — the percentile-plane piggyback.
+            req.hist_delta = hist_delta
         with self._refresh_lock:
             stub = self._stub
             state = {"gen": self._gen}

@@ -7,9 +7,11 @@ evaluation results go to the master's evaluation service; the train-end
 callback task runs model-export callbacks on exactly one worker.
 """
 
+import os
 import time
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import hist as hist_mod
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.retry import RetryPolicy
@@ -29,6 +31,37 @@ PREEMPTED_EXIT_CODE = 143
 class PreemptedExit(Exception):
     """Raised inside the task loop when a graceful-preemption stop was
     requested (SIGTERM): unwind cleanly after the current minibatch."""
+
+
+# Drill knob: "id:ms[,id:ms...]" — a deliberate per-step sleep for the
+# NAMED worker ids only (bench_elastic's straggler leg throttles one
+# member of a managed pool through the shared environment).
+ENV_STEP_THROTTLE = "ELASTICDL_STEP_THROTTLE_SPEC"
+
+
+def step_throttle_secs(worker_id, spec=None):
+    """Seconds of deliberate per-step sleep for ``worker_id`` under
+    the current ELASTICDL_STEP_THROTTLE_SPEC ("id:ms,..."), else 0.
+    Malformed specs are ignored loudly — a drill typo must never
+    change training behavior silently."""
+    spec = (os.environ.get(ENV_STEP_THROTTLE, "")
+            if spec is None else spec)
+    for piece in spec.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        try:
+            wid, ms = piece.split(":")
+            if int(wid) == worker_id:
+                throttle = float(ms) / 1e3
+                logger.warning(
+                    "worker %d DELIBERATELY throttled %.0f ms/step "
+                    "(%s)", worker_id, float(ms), ENV_STEP_THROTTLE)
+                return throttle
+        except ValueError:
+            logger.warning("ignoring bad %s piece %r",
+                           ENV_STEP_THROTTLE, piece)
+    return 0.0
 
 
 class Worker:
@@ -124,20 +157,41 @@ class Worker:
             self._job_config_key(initial_job_config)
             if initial_job_config else None
         )
+        # Drill-only deliberate slowdown (straggler staging): the
+        # ELASTICDL_STEP_THROTTLE_SPEC env names worker ids — every
+        # pool worker inherits the same env, each applies only its
+        # own entry, so a drill can throttle ONE member of a managed
+        # pool without per-worker plumbing.
+        self._step_throttle = step_throttle_secs(
+            getattr(master_client, "worker_id", -1))
         # (monotonic mark, steps at mark) for the steps/s telemetry
         # interval; written and read only on the training thread (the
         # progress-RPC flush runs there).
         self._tele_mark = (None, 0)
+        # Step-time histogram snapshot at the previous report — the
+        # piggybacked delta is cur - prev, so the master's merge stays
+        # an exact cumulative sum however reports interleave.  Same
+        # single-thread discipline as _tele_mark.
+        self._tele_hist_prev = None
 
     def _telemetry_snapshot(self):
         """Telemetry dict for the next progress RPC: worker-local
         steps/s over the interval since the previous report,
-        blocked-on-device fraction, PS push-pipeline depth, and the
-        mean fused-window size (docs/observability.md)."""
+        blocked-on-device fraction, PS push-pipeline depth, the mean
+        fused-window size, and the sparse step-time histogram delta
+        (docs/observability.md — the master's per-job p50/p99 and the
+        straggler detector derive from it)."""
         now = time.monotonic()
         mark_t, mark_steps = self._tele_mark
         self._tele_mark = (now, self._steps)
         out = {"steps_done": self._steps}
+        step_snap = self.timing.hist_snapshot("step_time")
+        if step_snap is not None:
+            d = hist_mod.delta(step_snap, self._tele_hist_prev)
+            self._tele_hist_prev = step_snap
+            if d["count"]:
+                out["hist_delta"] = hist_mod.encode_deltas(
+                    {"step_time": d})
         if mark_t is not None and now > mark_t and (
             self._steps > mark_steps
         ):
@@ -293,6 +347,11 @@ class Worker:
                         "step %d loss %.6f (version %d)",
                         self._steps, loss_value, version,
                     )
+                if self._step_throttle:
+                    # Drill knob (step_throttle_secs): a DELIBERATE
+                    # per-step slowdown so churn drills can stage a
+                    # straggler and gate the detector on it.
+                    time.sleep(self._step_throttle)
                 return loss
             except Exception as e:  # noqa: BLE001 — retry then surface
                 err = e
@@ -345,6 +404,7 @@ class Worker:
             elastic=self._elastic,
             stop_check=lambda: self._preempt_requested,
             callbacks=self._spec.callbacks,
+            step_throttle_secs=self._step_throttle,
             # Prep placement: producer thread when no elastic
             # controller (overlap), inside the driver AFTER the epoch
             # check otherwise — a world re-form can change batch
@@ -411,12 +471,20 @@ class Worker:
                     depth=2,
                 )
                 pending = next(batches, None)
+                t_prev = time.perf_counter()
                 while pending is not None:
                     features, labels, count = pending
                     pending = next(batches, None)
                     if pending is not None and prefetch_embeddings:
                         prefetch_embeddings(pending[0])
                     loss = self._process_minibatch(features, labels)
+                    # Per-step wall time into the step-time histogram
+                    # (the fused path observes per window); feeds the
+                    # master's per-job p50/p99 via the telemetry
+                    # piggyback's hist delta.
+                    t_now = time.perf_counter()
+                    self.timing.observe("step_time", t_now - t_prev)
+                    t_prev = t_now
                     if pending is None:
                         # Task-final fence: the last report below can
                         # auto-complete the task at the master, so the

@@ -114,6 +114,15 @@ EDITS = [
     ("ReportVersionRequest", "job_id", 6, F.TYPE_INT32, "jobId"),
     ("ReportEvaluationMetricsRequest", "job_id", 5, F.TYPE_INT32,
      "jobId"),
+    # Percentile-grade telemetry (docs/observability.md): a compact
+    # sparse histogram delta (utils/hist.py encode_deltas — fixed
+    # shared bucket bounds, so the master's merge is exact) rides the
+    # progress report the worker already sends every fused window.
+    # Today it carries the per-step step-time distribution; the
+    # master's per-job p50/p99 step time and the straggler detector
+    # both derive from it.
+    ("ReportBatchDoneRequest", "hist_delta", 9, F.TYPE_STRING,
+     "histDelta"),
 ]
 
 

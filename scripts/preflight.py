@@ -7,9 +7,13 @@ Runs, in order, each in a fresh subprocess with the CPU platform pinned:
      whole-program EL005 lock-order / EL006 blocking-under-lock /
      EL008 RPC-conformance pass; emits the EL005 lock-order graph to
      artifacts/lock_graph.dot)
-  2. the full test suite (pytest tests -q)
-  3. the driver's multi-chip dry run (__graft_entry__.dryrun_multichip(8))
-  4. one bench.py pass (CPU; validates the JSON contract end-to-end)
+  2. the Prometheus exposition-format conformance tests (every
+     /metrics renderer vs the strict parser + metric registry)
+  3. the full test suite (pytest tests -q)
+  4. the driver's multi-chip dry run (__graft_entry__.dryrun_multichip(8))
+  5. one bench.py pass (CPU; validates the JSON contract end-to-end)
+  6. bench_tracing.py with BOTH overhead gates (tracing <= 2%,
+     histogram path <= 2% steps/s)
 
 Exits nonzero on the FIRST failure with the failing stage named.  Run it
 before every end-of-round snapshot — round 2 shipped a broken HEAD
@@ -71,6 +75,19 @@ def main(argv=None):
     if not ok:
         return 1
 
+    # Exposition-format conformance next (seconds): every /metrics
+    # renderer against the strict parser + the metric registry —
+    # a malformed scrape or an undeclared series fails before the
+    # full suite spends any time.
+    ok, _ = run_stage(
+        "prom-exposition",
+        [sys.executable, "-m", "pytest",
+         "tests/test_prom_exposition.py", "-q"],
+        timeout=300,
+    )
+    if not ok:
+        return 1
+
     ok, _ = run_stage(
         "pytest", [sys.executable, "-m", "pytest", "tests", "-q"],
         extra_env={
@@ -110,6 +127,32 @@ def main(argv=None):
             return 1
         print("[preflight] bench value: %s %s"
               % (parsed["value"], parsed["unit"]))
+
+        # Observability-plane overhead gates (ISSUE 14): tracing AND
+        # histogram-path legs must both sit within the 2% steps/s
+        # budget.
+        ok, out = run_stage(
+            "bench_tracing.py (overhead gates)",
+            [sys.executable, "bench_tracing.py"],
+            timeout=900,
+        )
+        if not ok:
+            return 1
+        parsed = last_json_line(out)
+        detail = (parsed or {}).get("detail", {})
+        if not detail.get("within_2pct"):
+            print("[preflight] FAIL bench_tracing: tracing leg over "
+                  "the 2%% gate (ratio %s)" % (parsed or {}).get(
+                      "value"))
+            return 1
+        hist_leg = detail.get("histogram_path", {})
+        if not hist_leg.get("within_2pct"):
+            print("[preflight] FAIL bench_tracing: histogram leg "
+                  "over the 2%% gate (ratio %s)"
+                  % hist_leg.get("steps_ratio"))
+            return 1
+        print("[preflight] overhead ratios: tracing %s, histogram %s"
+              % (parsed["value"], hist_leg.get("steps_ratio")))
 
     print("[preflight] ALL GREEN")
     return 0

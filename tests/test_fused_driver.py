@@ -391,6 +391,27 @@ def test_timing_sync_fraction():
     assert t.sync_fraction("window_dispatch", "loss_sync") == 0.25
 
 
+def test_step_anatomy_phases_and_step_time_hist(dataset, spec):
+    """The fused loop decomposes into data_wait / host_prep /
+    window_dispatch / loss_sync / progress_rpc phases (each
+    histogram-backed via Timing), and observes one step_time sample
+    per step — the distribution the telemetry piggyback ships to the
+    master (docs/observability.md)."""
+    mc, _trainer, worker = run_worker(dataset, spec, fused_steps=4)
+    timing = worker.timing
+    step_snap = timing.hist_snapshot("step_time")
+    assert step_snap is not None
+    assert step_snap["count"] == worker._steps  # one sample per step
+    for phase in ("data_wait", "window_dispatch", "progress_rpc"):
+        snap = timing.hist_snapshot(phase)
+        assert snap is not None and snap["count"] > 0, phase
+    # host_prep only when staging ahead ran (device_prefetch > 0)
+    assert timing.hist_snapshot("host_prep") is not None
+    # and the telemetry snapshot carries the encoded delta
+    worker2_out = worker._telemetry_snapshot()
+    assert "hist_delta" in worker2_out
+
+
 def test_fused_flags_roundtrip_master_to_worker():
     args = parse_master_args([
         "--fused_steps", "8", "--device_prefetch", "4",

@@ -1,0 +1,552 @@
+"""Percentile plane + SLO watchdog tests (ISSUE 14).
+
+Covers utils/hist.py (fixed-bound streaming histograms, exact sparse-
+delta merge, windowed view), the Timing integration behind every
+phase mean, utils/slo.py (declarative rules, breach episodes,
+/alertz), the SIGQUIT live flight-recorder dump, /profilez, the
+master-side step-time aggregation + straggler detector fed by
+piggybacked worker deltas, and the ResizeController's straggler
+policy term.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.status_server import StatusServer
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.utils import hist, slo, tracing
+from elasticdl_tpu.utils.prom import to_prometheus
+from elasticdl_tpu.utils.timing import Timing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- hist.py -----------------------------------------------------------------
+
+def test_bucket_bounds_are_frozen():
+    """Cross-process exactness depends on every process agreeing on
+    the boundary list — a change here must be deliberate (and bump
+    DELTA_VERSION)."""
+    assert len(hist.BUCKET_BOUNDS) == 22
+    assert hist.BUCKET_BOUNDS[0] == pytest.approx(1e-5)
+    assert hist.BUCKET_BOUNDS[-1] == pytest.approx(100.0)
+    assert list(hist.BUCKET_BOUNDS) == sorted(hist.BUCKET_BOUNDS)
+    assert hist.N_BUCKETS == 23
+
+
+def test_observe_quantile_and_mean():
+    h = hist.Histogram()
+    for _ in range(90):
+        h.observe(0.001)
+    for _ in range(10):
+        h.observe(0.5)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["sum"] == pytest.approx(90 * 0.001 + 10 * 0.5)
+    p50 = hist.quantile(snap, 0.5)
+    p99 = hist.quantile(snap, 0.99)
+    assert p50 <= 0.001  # in the 0.001 bucket
+    assert 0.1 <= p99 <= 1.0  # in the 0.5 bucket's range
+    assert hist.mean(snap) == pytest.approx(snap["sum"] / 100)
+    assert hist.quantile(hist.empty_snapshot(), 0.99) is None
+
+
+def test_overflow_bucket_and_bulk_observe():
+    h = hist.Histogram()
+    h.observe(1e9, n=3)  # beyond the top bound -> overflow bucket
+    snap = h.snapshot()
+    assert snap["counts"][-1] == 3
+    assert snap["count"] == 3
+    # quantile caps at the top finite bound (the scraper convention)
+    assert hist.quantile(snap, 0.5) == hist.BUCKET_BOUNDS[-1]
+
+
+def test_sparse_delta_round_trip_is_exact():
+    h = hist.Histogram()
+    for v in (0.001, 0.002, 0.004, 0.1, 3.0):
+        h.observe(v)
+    first = h.snapshot()
+    for v in (0.002, 0.002, 50.0):
+        h.observe(v)
+    second = h.snapshot()
+    d1 = hist.delta(first, None)
+    d2 = hist.delta(second, first)
+    payload1 = hist.encode_deltas({"step_time": d1})
+    payload2 = hist.encode_deltas({"step_time": d2})
+    acc = hist.empty_snapshot()
+    hist.merge_delta(acc, hist.decode_deltas(payload1)["step_time"])
+    hist.merge_delta(acc, hist.decode_deltas(payload2)["step_time"])
+    assert acc == second  # EXACT, bit-for-bit, including the float sum
+
+
+def test_decode_rejects_garbage_and_foreign_versions():
+    assert hist.decode_deltas("") == {}
+    assert hist.decode_deltas("h9|x;s=1;n=1;b=0:1") == {}  # version
+    assert hist.decode_deltas("h1|torn;s=1") == {}
+    assert hist.decode_deltas("h1|x;s=1;n=1;b=99:1") == {}  # bad index
+    # empty deltas encode to "" (nothing to send)
+    assert hist.encode_deltas(
+        {"x": {"sum": 0.0, "count": 0, "buckets": {}}}) == ""
+
+
+def test_recent_windows_rotate():
+    h = hist.Histogram()
+    assert h.recent(1.0, now=0.0) is None
+    h.observe(0.01)
+    first = h.recent(1.0, now=0.0)     # establishes the mark
+    assert first["count"] == 1
+    for _ in range(5):
+        h.observe(0.02)
+    rotated = h.recent(1.0, now=2.0)   # window elapsed -> delta
+    assert rotated["count"] == 5       # only the new observations
+    # a read inside the next window returns the last COMPLETED delta
+    h.observe(0.03)
+    assert h.recent(1.0, now=2.5)["count"] == 5
+
+
+# -- Timing integration ------------------------------------------------------
+
+def test_timing_feeds_histograms_and_percentiles():
+    t = Timing()
+    t.observe("phase", 0.002, n=4)
+    with t.timeit("phase"):
+        pass
+    snap = t.hist_snapshot("phase")
+    assert snap["count"] == 5
+    assert t.percentile("phase", 0.5) is not None
+    assert "phase" in t.histograms()
+    assert t.histograms(names=("other",)) == {}
+    assert t.hist_snapshot("missing") is None
+    assert t.percentile("missing", 0.99) is None
+
+
+def test_hist_global_off_switch():
+    t = Timing()
+    hist.set_enabled(False)
+    try:
+        t.observe("x", 0.01)
+    finally:
+        hist.set_enabled(True)
+    # mean path unaffected, histogram path off
+    assert t.summary()["x"]["count"] == 1
+    assert t.hist_snapshot("x") is None
+    t.observe("x", 0.01)
+    assert t.hist_snapshot("x")["count"] == 1
+
+
+# -- slo.py ------------------------------------------------------------------
+
+def test_rule_parse_and_reject():
+    r = slo.SloRule("p99(batcher.queue_wait) < 0.05")
+    assert (r.fn, r.source, r.op, r.threshold) == (
+        "p99", "batcher.queue_wait", "<", 0.05)
+    assert slo.SloRule("value(x) >= 1e-3", name="n").name == "n"
+    assert slo.SloRule("mean(a.b) > 2").fn == "mean"
+    with pytest.raises(ValueError):
+        slo.SloRule("p99 batcher < 1")
+    with pytest.raises(ValueError):
+        slo.SloRule("max(x) < 1")
+
+
+def test_breach_episodes_and_recorder_event():
+    recorder = tracing.FlightRecorder(64)
+    tracer = tracing.Tracer(recorder=recorder, enabled=True)
+    wd = slo.SloWatchdog(tracer=tracer)
+    box = {"v": 1.0}
+    wd.add_source("freshness", lambda: box["v"])
+    wd.add_rule("value(freshness) < 10", name="fresh")
+    assert wd.evaluate()["fresh"]["ok"]
+    box["v"] = 50.0
+    r = wd.evaluate()
+    assert not r["fresh"]["ok"] and r["fresh"]["breached_now"]
+    wd.evaluate()  # still breaching: same EPISODE, no second event
+    box["v"] = 2.0
+    wd.evaluate()  # recover
+    box["v"] = 99.0
+    wd.evaluate()  # second episode
+    payload = wd.payload(evaluate=False)
+    assert payload["rules"]["fresh"]["breach_total"] == 2
+    breaches = [e for e in recorder.snapshot()
+                if e and e.get("name") == "slo.breach"]
+    assert len(breaches) == 2
+    assert breaches[0]["attrs"]["rule"] == "fresh"
+    assert breaches[0]["attrs"]["threshold"] == 10.0
+
+
+def test_no_data_and_broken_sources_never_breach():
+    wd = slo.SloWatchdog()
+    wd.add_source("gone", lambda: None)
+    wd.add_rule("value(gone) < 1", name="gone")
+    wd.add_source("boom", lambda: 1 / 0)
+    wd.add_rule("value(boom) < 1", name="boom")
+    wd.add_rule("p99(never_observed) < 1", name="unbound")
+    results = wd.evaluate()
+    assert all(r["ok"] for r in results.values())
+    assert wd.payload(evaluate=False)["breaching"] == []
+
+
+def test_pxx_rules_resolve_bound_timing():
+    t = Timing()
+    for _ in range(100):
+        t.observe("lat", 0.2)
+    wd = slo.SloWatchdog(tracer=tracing.Tracer(
+        recorder=tracing.FlightRecorder(8), enabled=True))
+    wd.bind_timing(t)
+    wd.add_rule("p99(lat) < 0.05", name="lat")
+    assert not wd.evaluate()["lat"]["ok"]
+
+
+def test_arm_from_env_skips_bad_specs():
+    wd = slo.SloWatchdog()
+    wd.arm_from_env("myname=value(x) < 3; p95(y) > 0.1; garbage;;")
+    assert wd.rule_count == 2
+    payload = wd.payload(evaluate=True)
+    assert set(payload["rules"]) == {"myname", "p95_y"}
+
+
+def test_alertz_served_by_status_server(monkeypatch):
+    wd = slo.SloWatchdog()
+    wd.add_source("x", lambda: 5.0)
+    wd.add_rule("value(x) < 1", name="x_low")
+    monkeypatch.setattr(slo, "_WATCHDOG", wd)
+    tm = TaskManager(training_shards=[("f", 0, 32)],
+                     records_per_task=32)
+    server = StatusServer(tm, port=0, host="127.0.0.1")
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/alertz" % server.port) as resp:
+            body = json.loads(resp.read())
+        assert body["breaching"] == ["x_low"]
+        assert body["rules"]["x_low"]["value"] == 5.0
+        # the status payload carries the slo section for /metrics
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % server.port) as resp:
+            text = resp.read().decode()
+        assert 'elasticdl_slo_ok{rule="x_low"} 0' in text
+        assert 'elasticdl_slo_breach_total{rule="x_low"}' in text
+    finally:
+        server.stop()
+
+
+# -- /profilez ---------------------------------------------------------------
+
+class _FakeProfiler:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def start_trace(self, path):
+        if self.fail:
+            raise RuntimeError("no profiler backend")
+        self.calls.append(("start", path))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+def test_profilez_capture_links_trace(tmp_path):
+    recorder = tracing.FlightRecorder(64)
+    tracer = tracing.Tracer(recorder=recorder, enabled=True)
+    fake = _FakeProfiler()
+    with tracer.span("worker.task", task=7):
+        body = json.loads(tracing.profilez_body(
+            "/profilez?secs=0", trace_dir=str(tmp_path),
+            profiler=fake, tracer=tracer))
+    assert body["ok"]
+    assert body["dir"].startswith(str(tmp_path))
+    assert os.path.isdir(body["dir"])
+    assert [c[0] for c in fake.calls] == ["start", "stop"]
+    # the capture event is in the ring, inside the requesting trace
+    capture = [e for e in recorder.snapshot()
+               if e and e.get("name") == "profile.capture"]
+    assert capture and capture[0]["attrs"]["dir"] == body["dir"]
+    assert body["trace"] == capture[0]["trace"]
+
+
+def test_profilez_bad_query_and_failing_backend(tmp_path):
+    assert not json.loads(
+        tracing.profilez_body("/profilez?secs=abc"))["ok"]
+    tracer = tracing.Tracer(recorder=tracing.FlightRecorder(8),
+                            enabled=True)
+    body = json.loads(tracing.profilez_body(
+        "/profilez?secs=0", trace_dir=str(tmp_path),
+        profiler=_FakeProfiler(fail=True), tracer=tracer))
+    assert not body["ok"] and "no profiler backend" in body["error"]
+    # the in-progress guard released: a second capture may run
+    body2 = json.loads(tracing.profilez_body(
+        "/profilez?secs=0", trace_dir=str(tmp_path),
+        profiler=_FakeProfiler(), tracer=tracer))
+    assert body2["ok"]
+
+
+# -- SIGQUIT live dump -------------------------------------------------------
+
+@pytest.mark.skipif(not hasattr(signal, "SIGQUIT"),
+                    reason="platform without SIGQUIT")
+def test_sigquit_dumps_ring_without_exiting(tmp_path):
+    """kill -QUIT a wedged process: the ring lands on disk and the
+    process KEEPS RUNNING (live inspection), unlike SIGTERM."""
+    script = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from elasticdl_tpu.utils import tracing\n"
+        "tracing.configure_identity('quitproc')\n"
+        "tracing.event('alive')\n"
+        "tracing.arm_crash_dump()\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n" % REPO
+    )
+    env = dict(os.environ, ELASTICDL_TRACE_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        os.kill(proc.pid, signal.SIGQUIT)
+        deadline = time.monotonic() + 10
+        dump = None
+        while time.monotonic() < deadline and dump is None:
+            dumps = [f for f in os.listdir(str(tmp_path))
+                     if f.endswith(".trace.json")]
+            if dumps:
+                dump = dumps[0]
+            else:
+                time.sleep(0.05)
+        assert dump is not None, "no dump after SIGQUIT"
+        # STILL ALIVE: that is the whole point
+        time.sleep(0.2)
+        assert proc.poll() is None
+        with open(os.path.join(str(tmp_path), dump)) as f:
+            events = json.load(f)["events"]
+        names = [e.get("name") for e in events if e]
+        assert "alive" in names and "sigquit" in names
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+# -- master aggregation + straggler detection --------------------------------
+
+def _hist_payload(values):
+    h = hist.Histogram()
+    for v in values:
+        h.observe(v)
+    return hist.encode_deltas(
+        {"step_time": hist.delta(h.snapshot(), None)})
+
+
+def _report(servicer, worker_id, values, steps=10):
+    servicer.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=worker_id, record_count=32, steps_done=steps,
+        steps_per_sec=5.0, hist_delta=_hist_payload(values)))
+
+
+def _servicer():
+    tm = TaskManager(training_shards=[("f", 0, 64)],
+                     records_per_task=32)
+    return MasterServicer(tm)
+
+
+def test_hist_delta_ingest_feeds_job_p50_p99():
+    sv = _servicer()
+    _report(sv, 1, [0.01] * 8)
+    _report(sv, 2, [0.02] * 8)
+    tele = sv.telemetry()
+    job = tele["job"]
+    assert job["step_hist"]["count"] == 16
+    assert job["step_time_p50_ms"] < job["step_time_p99_ms"]
+    # /metrics renders the job histogram natively
+    text = to_prometheus({
+        "tasks": {"todo": 0, "doing": 0, "epoch": 0,
+                  "completed": {}, "failed": {}},
+        "finished": False, "telemetry": tele,
+    })
+    assert "elasticdl_job_step_time_seconds_bucket" in text
+    assert "elasticdl_job_step_time_seconds_count 16" in text
+
+
+def test_garbage_hist_delta_is_dropped_not_fatal():
+    sv = _servicer()
+    sv.report_batch_done(pb.ReportBatchDoneRequest(
+        worker_id=1, record_count=32, steps_done=1,
+        hist_delta="h1|torn-garbage"))
+    assert "step_hist" not in sv.telemetry()["job"]
+
+
+def test_straggler_flagged_within_sweeps_and_surfaced():
+    """The acceptance shape: a deliberately slow worker is FLAGGED on
+    the first sweep that sees its skewed window and SUSTAINED within
+    STRAGGLER_SUSTAIN_SWEEPS — surfaced on telemetry/'/status',
+    /metrics (elasticdl_worker_straggler), and as an slo.breach in
+    the flight recorder + /alertz via the straggler source."""
+    sv = _servicer()
+    recorder = tracing.FlightRecorder(64)
+    tracer = tracing.Tracer(recorder=recorder, enabled=True)
+    wd = slo.SloWatchdog(tracer=tracer)
+    wd.add_source("straggler_workers",
+                  lambda: float(len(sv.stragglers())))
+    wd.add_rule("value(straggler_workers) < 1", name="stragglers")
+
+    for sweep in range(sv.STRAGGLER_SUSTAIN_SWEEPS):
+        _report(sv, 1, [0.01] * 8)   # healthy
+        _report(sv, 2, [0.01] * 8)   # healthy
+        _report(sv, 3, [0.2] * 8)    # 20x the median: the straggler
+        sv.straggler_sweep()
+        wd.evaluate()
+        if sweep == 0:
+            # flagged within ONE sweep cadence of reporting skew
+            with sv._lock:
+                assert sv._straggler_state[3]["flagged"] == 1
+            assert sv.stragglers() == []  # not yet sustained
+    assert sv.stragglers() == [3]
+    tele = sv.telemetry()
+    assert tele["workers"][3]["straggler"] is True
+    assert tele["workers"][1]["straggler"] is False
+    assert tele["workers"][3]["step_p50_ms"] > (
+        tele["workers"][1]["step_p50_ms"])
+    text = to_prometheus({
+        "tasks": {"todo": 0, "doing": 0, "epoch": 0,
+                  "completed": {}, "failed": {}},
+        "finished": False, "telemetry": tele,
+    })
+    assert 'elasticdl_worker_straggler{worker="3"} 1' in text
+    assert 'elasticdl_worker_straggler{worker="1"} 0' in text
+    # straggler event in the recorder + SLO breach on /alertz
+    names = [e.get("name") for e in recorder.snapshot() if e]
+    assert "slo.breach" in names
+    assert not wd.payload(evaluate=False)["rules"]["stragglers"]["ok"]
+    straggle = [e for e in tracing.default_tracer().recorder.snapshot()
+                if e and e.get("name") == "worker.straggler"]
+    assert any(e["attrs"]["worker"] == 3 for e in straggle)
+
+
+def test_straggler_detectable_in_two_worker_job_and_recovers():
+    """Leave-one-out median: even a TWO-worker job can flag its slow
+    member (a plain median caps the ratio at 2.0 there), and the flag
+    clears on the first healthy window."""
+    sv = _servicer()
+    for _ in range(sv.STRAGGLER_SUSTAIN_SWEEPS):
+        _report(sv, 1, [0.01] * 8)
+        _report(sv, 2, [0.2] * 8)
+        sv.straggler_sweep()
+    assert sv.stragglers() == [2]
+    _report(sv, 1, [0.01] * 8)
+    _report(sv, 2, [0.01] * 8)  # recovered
+    sv.straggler_sweep()
+    assert sv.stragglers() == []
+
+
+def test_straggler_needs_min_samples_and_two_workers():
+    sv = _servicer()
+    _report(sv, 1, [0.2] * 8)
+    assert sv.straggler_sweep() == []  # one worker: skew undefined
+    _report(sv, 1, [0.01] * 8)
+    _report(sv, 2, [0.5] * 2)  # below STRAGGLER_MIN_SAMPLES
+    sv.straggler_sweep()
+    with sv._lock:
+        assert sv._straggler_state.get(2, {}).get("flagged", 0) == 0
+
+
+def test_rpc_handle_histograms_exposed():
+    sv = _servicer()
+    sv.get_task(pb.GetTaskRequest(worker_id=0))
+    _report(sv, 1, [0.01] * 4)
+    hists = sv.rpc_histograms()
+    assert hists["get_task"]["count"] == 1
+    assert hists["report_batch_done"]["count"] == 1
+
+
+# -- ResizeController policy term --------------------------------------------
+
+def test_resize_controller_prefers_straggler_donor():
+    from tests.test_scheduler import make_cluster
+
+    registry, ctrl, sv, _jobs = make_cluster(
+        [dict(name="a", n_tasks=8), dict(name="b", n_tasks=2)],
+        pool_size=4,
+    )
+    b_tasks = {}
+    for wid in range(4):
+        res = sv.get_task(pb.GetTaskRequest(worker_id=wid))
+        if res.job_id == 2:
+            b_tasks[wid] = res.task.id
+    b_workers = sorted(b_tasks)
+    assert len(b_workers) == 2
+    # Drop job b's demand below its 2 workers (complete one task):
+    # b becomes over-target and donates one worker.  Newest-first
+    # would donate max(b_workers); flag the OLDER one as a sustained
+    # straggler and the policy term must pick IT instead.
+    straggler = min(b_workers)
+    sv.report_task_result(pb.ReportTaskResultRequest(
+        task_id=b_tasks[straggler], job_id=2))
+    ctrl._stragglers = {straggler}
+    moves = ctrl._rebalance()
+    assert (straggler, 2, 1) in moves
+
+
+def test_step_throttle_spec_targets_one_worker():
+    from elasticdl_tpu.worker.worker import step_throttle_secs
+
+    assert step_throttle_secs(1, "1:120") == pytest.approx(0.12)
+    assert step_throttle_secs(0, "1:120") == 0.0
+    assert step_throttle_secs(2, "1:120,2:50") == pytest.approx(0.05)
+    assert step_throttle_secs(1, "") == 0.0
+    assert step_throttle_secs(1, "garbage,1:oops") == 0.0  # loud skip
+
+
+# -- elastic-lint EL010 ------------------------------------------------------
+
+def _el010(source):
+    from tools.elastic_lint import check_source
+
+    return [f for f in check_source(source, "fixture.py")
+            if f.rule == "EL010"]
+
+
+def test_el010_flags_undeclared_series():
+    bad = (
+        "def render(lines):\n"
+        "    lines.append(prometheus_line("
+        "'elasticdl_slo_okk', 1))\n"   # typo'd
+    )
+    findings = _el010(bad)
+    assert len(findings) == 1
+    assert "elasticdl_slo_okk" in findings[0].message
+
+
+def test_el010_accepts_declared_series_and_templates():
+    good = (
+        "def render(lines, kind, snap):\n"
+        "    lines.append(prometheus_line("
+        "'elasticdl_workers_live', 3))\n"
+        "    lines.append(prometheus_line("
+        "'elasticdl_tasks_%s' % kind, 1))\n"
+        "    histogram_lines(lines, "
+        "'elasticdl_job_step_time_seconds', snap)\n"
+        "    lines.append(prometheus_line(other_metric, 1))\n"  # dynamic:
+        # out of scope by design (exposition test catches at render)
+    )
+    assert _el010(good) == []
+
+
+def test_el010_flags_histogram_gauge_kind_mismatch():
+    bad = (
+        "def render(lines, snap):\n"
+        "    histogram_lines(lines, "
+        "'elasticdl_workers_live', snap)\n"      # declared gauge
+        "    lines.append(prometheus_line("
+        "'elasticdl_job_step_time_seconds', 1))\n"  # declared histogram
+    )
+    findings = _el010(bad)
+    assert len(findings) == 2
+    assert all("declared" in f.message for f in findings)

@@ -1,0 +1,394 @@
+"""Strict Prometheus exposition-format conformance over EVERY
+renderer (ISSUE 14 satellite): master, multi-tenant master, serving
+replica, fleet router, PS shard.
+
+The parser here is deliberately unforgiving — line grammar from the
+exposition-format spec, label-escaping round-trip, histogram bucket
+monotonicity (cumulative nondecreasing, ascending ``le``, the
+mandatory ``+Inf`` row equal to ``_count``), and no duplicate series
+(metric + label-set unique per scrape).  A renderer that emits
+something a real scraper would mis-parse fails HERE, not in some
+dashboard three weeks later.
+
+Also the registry cross-checks (elastic-lint EL010's runtime halves):
+every emitted name must be declared in utils/metric_registry.py, and
+every ``elasticdl_*`` token in the docs' metric tables must be
+declared too — docs cannot drift from the registry.
+"""
+
+import glob
+import os
+import re
+
+import pytest
+
+from elasticdl_tpu.utils import hist, metric_registry
+from elasticdl_tpu.utils.prom import (
+    fleet_to_prometheus,
+    multitenant_to_prometheus,
+    ps_to_prometheus,
+    serving_to_prometheus,
+    to_prometheus,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LINE_RE = re.compile(
+    r"^(?P<name>%s)(?:\{(?P<labels>[^{}]*)\})? (?P<value>\S+)$"
+    % _NAME)
+_LABEL_RE = re.compile(
+    r'^(?P<name>%s)="(?P<value>(?:[^"\\\n]|\\\\|\\"|\\n)*)"$' % _NAME)
+
+
+def parse_exposition(text):
+    """Parse one scrape strictly; returns [(name, labels_dict, value)]
+    and raises AssertionError on any grammar violation."""
+    assert text.endswith("\n"), "scrape must end with a newline"
+    samples = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        assert m, "line %d fails exposition grammar: %r" % (
+            lineno, line)
+        labels = {}
+        raw = m.group("labels")
+        if raw is not None:
+            assert raw != "", "empty label braces: %r" % line
+            # split on commas NOT inside quotes
+            parts = re.findall(
+                r'(?:[^,"]|"(?:[^"\\]|\\.)*")+', raw)
+            assert ",".join(parts) == raw, (
+                "label split mismatch: %r" % line)
+            for part in parts:
+                lm = _LABEL_RE.match(part)
+                assert lm, "bad label pair %r in %r" % (part, line)
+                assert lm.group("name") not in labels, (
+                    "duplicate label %r in %r" % (lm.group("name"),
+                                                  line))
+                labels[lm.group("name")] = lm.group("value")
+        value = m.group("value")
+        if value not in ("+Inf", "-Inf", "NaN"):
+            float(value)  # must parse
+        samples.append((m.group("name"), labels, value))
+    return samples
+
+
+def check_scrape(text):
+    """Full conformance: grammar, duplicate series, histogram
+    invariants, registry membership.  Returns the parsed samples."""
+    samples = parse_exposition(text)
+    seen = set()
+    for name, labels, _ in samples:
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, "duplicate series %s%r" % (name,
+                                                           labels)
+        seen.add(key)
+    _check_histograms(samples)
+    for name, _, _ in samples:
+        assert metric_registry.is_declared(name), (
+            "series %r not declared in utils/metric_registry.py"
+            % name)
+    return samples
+
+
+def _check_histograms(samples):
+    by_series = {}
+    for name, labels, value in samples:
+        by_series.setdefault(name, []).append((labels, value))
+    for name in {n[: -len("_bucket")] for n, _, _ in samples
+                 if n.endswith("_bucket")}:
+        buckets = {}
+        for labels, value in by_series.get(name + "_bucket", []):
+            group = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "le"))
+            buckets.setdefault(group, []).append(
+                (labels["le"], value))
+        for group, rows in buckets.items():
+            les = [le for le, _ in rows]
+            assert les[-1] == "+Inf", (
+                "%s%r: last bucket must be +Inf" % (name, group))
+            finite = [float(le) for le in les[:-1]]
+            assert finite == sorted(finite), (
+                "%s%r: le values not ascending" % (name, group))
+            counts = [float(v) for _, v in rows]
+            assert counts == sorted(counts), (
+                "%s%r: cumulative bucket counts decrease"
+                % (name, group))
+            # _count must exist for the same label group and equal
+            # the +Inf bucket; _sum must exist.
+            count_rows = {
+                tuple(sorted(labels.items())): float(v)
+                for labels, v in by_series.get(name + "_count", [])
+            }
+            sum_rows = {
+                tuple(sorted(labels.items()))
+                for labels, _ in by_series.get(name + "_sum", [])
+            }
+            assert group in count_rows, "%s%r: missing _count" % (
+                name, group)
+            assert count_rows[group] == counts[-1], (
+                "%s%r: +Inf bucket != _count" % (name, group))
+            assert group in sum_rows, "%s%r: missing _sum" % (name,
+                                                              group)
+
+
+def _snap(values):
+    h = hist.Histogram()
+    for v in values:
+        h.observe(v)
+    return h.snapshot()
+
+
+def _telemetry():
+    job_hist = _snap([0.01, 0.02, 0.02, 0.4])
+    return {
+        "workers": {
+            1: {"steps_per_sec": 10.0, "sync_fraction": 0.25,
+                "push_staleness": 1.0, "window_size": 8.0,
+                "steps_done": 100, "fresh": True, "age_secs": 1.0,
+                "straggler": False, "step_p50_ms": 12.0},
+            2: {"steps_per_sec": 2.0, "sync_fraction": None,
+                "push_staleness": None, "window_size": None,
+                "steps_done": 40, "fresh": True, "age_secs": 2.0,
+                "straggler": True, "step_p50_ms": 48.0},
+        },
+        "job": {"steps_per_sec": 12.0, "workers_reporting": 2,
+                "step_hist": job_hist,
+                "step_time_p50_ms": 20.0, "step_time_p99_ms": 380.0},
+    }
+
+
+_SLO = {
+    "rules": {
+        "agg_freshness": {"ok": True, "breach_total": 2},
+        "stragglers": {"ok": False, "breach_total": 1},
+    },
+}
+
+
+def master_status():
+    return {
+        "tasks": {"todo": 3, "doing": 1, "epoch": 0,
+                  "completed": {"training": 5, "evaluation": 1},
+                  "failed": {"training": 1}},
+        "finished": False,
+        "workers": {"live": [1, 2]},
+        "rendezvous": {"epoch": 4, "world": ["w1", "w2"]},
+        "exec_counters": {"batch_count": 12},
+        "telemetry": _telemetry(),
+        "ps": {"shards": {0: {"generation": 2, "version": 9,
+                              "durable_version": 8}},
+               "commit_mark": 8},
+        "rpc_hists": {"get_task": _snap([0.001, 0.002]),
+                      "report_batch_done": _snap([0.0005] * 10)},
+        "slo": _SLO,
+    }
+
+
+def multitenant_status():
+    return {
+        "sched": {"pool_workers": 4, "pending_jobs": 1,
+                  "decisions": {"admit": 2, "assign": 4},
+                  "workers_assigned": {"job-a": 3, "job-b": 1},
+                  "hists": {"tick": _snap([0.002, 0.004])}},
+        "jobs": {
+            "job-a": {
+                "state": "running",
+                "tasks": {"todo": 1, "doing": 2, "epoch": 0,
+                          "completed": {"training": 7},
+                          "failed": {}},
+                "finished": False,
+                "telemetry": _telemetry(),
+                "exec_counters": {"batch_count": 5},
+                "rendezvous": {"epoch": 2, "world": ["w1"]},
+            },
+            'job-"b"\n': {  # hostile name: escaping must hold
+                "state": "pending",
+                "tasks": {"todo": 0, "doing": 0, "epoch": 0,
+                          "completed": {}, "failed": {}},
+                "finished": False,
+                "telemetry": {"workers": {}, "job": {}},
+                "exec_counters": {},
+            },
+        },
+        "workers": {"live": [1, 2, 3, 4]},
+        "slo": _SLO,
+    }
+
+
+def serving_status():
+    return {
+        "draining": False,
+        "models": {
+            "m": {
+                "version": 7,
+                "counters": {"batcher.requests": 100,
+                             "batcher.batches": 20,
+                             "batcher.rows": 90},
+                "timing": {"batcher.queue_wait":
+                           {"total_s": 0.5, "count": 100,
+                            "mean_s": 0.005}},
+                "mean_batch_occupancy": 4.5,
+                "queue_wait_recent_ms": 3.25,
+                "hists": {
+                    "batcher.queue_wait": _snap([0.004] * 100),
+                    "batcher.execute": _snap([0.02] * 20),
+                },
+                "emb_cache": {"bytes": 1024, "rows": 8,
+                              "evicted_rows": 2, "hit_ratio": 0.75},
+            },
+        },
+        "slo": _SLO,
+    }
+
+
+def fleet_status():
+    return {
+        "committed_version": 7,
+        "replicas": {
+            "127.0.0.1:9001": {"healthy": True, "serving_version": 7,
+                               "inflight": 2, "queue_wait_ms": 4.0,
+                               "queue_wait_recent_ms": 2.0},
+            "127.0.0.1:9002": {"healthy": False, "serving_version": 6,
+                               "inflight": 0, "queue_wait_ms": None,
+                               "queue_wait_recent_ms": None},
+        },
+        "counters": {"router.forwarded": 500, "router.retried": 1},
+        "latency_hists": {"127.0.0.1:9001": _snap([0.01] * 50)},
+        "canary": {
+            "active": True, "version": 8, "fraction": 0.25,
+            "replicas": ["127.0.0.1:9002"],
+            "cohorts": {
+                "baseline": {"requests": 400, "keyed_requests": 100,
+                             "errors": 1, "latency_ms_sum": 4000.0,
+                             "model_version": 7,
+                             "latency_hist": _snap([0.01] * 400)},
+                "canary": {"requests": 100, "keyed_requests": 100,
+                           "errors": 0, "latency_ms_sum": 900.0,
+                           "model_version": 8,
+                           "latency_hist": _snap([0.009] * 100)},
+            },
+        },
+        "aggregation": {"freshness_seconds": 1.25, "version": 8},
+        "slo": _SLO,
+    }
+
+
+def ps_status():
+    return {
+        "ps_id": 0, "num_ps": 2, "version": 9, "generation": 2,
+        "durable_version": 8, "initialized": True,
+        "counters": {"push_accepted": 50, "pull_dense": 10},
+        "hists": {"ps.push_handle": _snap([0.002] * 50),
+                  "ps.pull_dense": _snap([0.004] * 10),
+                  "ps.pull_embedding": _snap([0.001] * 5)},
+        "slo": _SLO,
+    }
+
+
+RENDERERS = [
+    ("master", to_prometheus, master_status),
+    ("multitenant", multitenant_to_prometheus, multitenant_status),
+    ("serving", serving_to_prometheus, serving_status),
+    ("fleet", fleet_to_prometheus, fleet_status),
+    ("ps", ps_to_prometheus, ps_status),
+]
+
+
+@pytest.mark.parametrize("name,renderer,status",
+                         RENDERERS, ids=[r[0] for r in RENDERERS])
+def test_renderer_conforms(name, renderer, status):
+    samples = check_scrape(renderer(status()))
+    assert samples, "renderer %s emitted nothing" % name
+
+
+def test_histograms_render_on_every_latency_surface():
+    """The tentpole invariant: every latency series on every /metrics
+    surface has a native histogram a scraper can take p99 of."""
+    expectations = [
+        (to_prometheus(master_status()),
+         ["elasticdl_master_rpc_handle_seconds_bucket",
+          "elasticdl_job_step_time_seconds_bucket"]),
+        (multitenant_to_prometheus(multitenant_status()),
+         ["elasticdl_sched_decision_seconds_bucket",
+          "elasticdl_job_step_time_seconds_bucket"]),
+        (serving_to_prometheus(serving_status()),
+         ["elasticdl_serving_queue_wait_seconds_bucket",
+          "elasticdl_serving_execute_seconds_bucket"]),
+        (fleet_to_prometheus(fleet_status()),
+         ["elasticdl_fleet_replica_latency_seconds_bucket",
+          "elasticdl_fleet_cohort_latency_seconds_bucket"]),
+        (ps_to_prometheus(ps_status()),
+         ["elasticdl_ps_push_handle_seconds_bucket",
+          "elasticdl_ps_pull_dense_seconds_bucket",
+          "elasticdl_ps_pull_embedding_seconds_bucket"]),
+    ]
+    for text, names in expectations:
+        for metric in names:
+            assert metric + "{" in text or metric + " " in text, (
+                "missing histogram %s" % metric)
+
+
+def test_label_escaping_round_trips_hostile_job_name():
+    text = multitenant_to_prometheus(multitenant_status())
+    samples = parse_exposition(text)
+    hostile = [labels for _, labels, _ in samples
+               if "job" in labels and "\\" in repr(labels["job"])]
+    assert any(labels["job"] == 'job-\\"b\\"\\n' for labels in hostile)
+
+
+def test_parser_rejects_bad_lines():
+    with pytest.raises(AssertionError):
+        parse_exposition("elasticdl_x{le=0.1} 3\n")  # unquoted label
+    with pytest.raises(AssertionError):
+        parse_exposition("3elasticdl_x 1\n")  # bad metric name
+    with pytest.raises(AssertionError):
+        parse_exposition("elasticdl_x 1")  # missing trailing newline
+    with pytest.raises(AssertionError):
+        parse_exposition('elasticdl_x{a="1",a="2"} 1\n')  # dup label
+
+
+def test_parser_rejects_broken_histogram():
+    # cumulative counts must be nondecreasing
+    bad = ('elasticdl_h_bucket{le="0.1"} 5\n'
+           'elasticdl_h_bucket{le="+Inf"} 3\n'
+           'elasticdl_h_sum 1.0\n'
+           'elasticdl_h_count 3\n')
+    with pytest.raises(AssertionError):
+        _check_histograms(parse_exposition(bad))
+
+
+def test_duplicate_series_detected():
+    with pytest.raises(AssertionError):
+        check_scrape("elasticdl_workers_live 1\n"
+                     "elasticdl_workers_live 2\n")
+
+
+# -- registry cross-checks ---------------------------------------------------
+
+def test_docs_metric_tables_match_registry():
+    tokens = set()
+    for path in glob.glob(os.path.join(REPO, "docs", "*.md")):
+        with open(path, encoding="utf-8") as f:
+            tokens.update(re.findall(r"elasticdl_[a-z0-9_]+",
+                                     f.read()))
+    undeclared = sorted(
+        t for t in tokens
+        # Trailing-underscore tokens are brace-expansion shorthand
+        # ("elasticdl_slo_{ok,breach_total}"): the prefix itself is
+        # not a series.
+        if not t.endswith("_")
+        and not metric_registry.is_declared(t)
+        and not t.startswith("elasticdl_tpu")  # the package name
+    )
+    assert not undeclared, (
+        "docs mention series not in utils/metric_registry.py: %s"
+        % undeclared)
+
+
+def test_registry_has_no_blank_help():
+    for name, meta in metric_registry.METRICS.items():
+        assert meta["help"].strip(), "registry entry %r has no help" % (
+            name)

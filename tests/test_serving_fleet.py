@@ -151,10 +151,11 @@ def test_rendezvous_addition_steals_about_one_nth():
 
 
 def test_statz_view_takes_min_version_across_models():
-    version, occupancy, wait_ms, draining = _statz_view({
+    version, occupancy, wait_ms, recent_ms, draining = _statz_view({
         "draining": False,
         "models": {
             "a": {"version": 7, "mean_batch_occupancy": 3.0,
+                  "queue_wait_recent_ms": 1.5,
                   "timing": {"batcher.queue_wait":
                              {"mean_s": 0.002, "count": 5}}},
             "b": {"version": 5, "mean_batch_occupancy": None,
@@ -164,6 +165,7 @@ def test_statz_view_takes_min_version_across_models():
     assert version == 5  # the barrier must hold for EVERY model
     assert occupancy == 3.0
     assert wait_ms == pytest.approx(2.0)
+    assert recent_ms == pytest.approx(1.5)
     assert draining is False
 
 

@@ -29,6 +29,11 @@ Per-file rules (each in its own module, registered in ``RULES``):
                           (its blocking-record half rides EL006: the
                           blocking registry lists flight-recorder
                           ``dump`` but not ``record``)
+  EL010 metric-registry   every emitted ``elasticdl_*`` Prometheus
+                          series must be declared in
+                          utils/metric_registry.py (typo'd /
+                          undocumented / duplicate series fail;
+                          histogram-vs-gauge kind must match)
 
 Whole-program rules (``PROGRAM_RULES``, run over the stitched
 ``program.Program`` model of every scanned file):
@@ -75,6 +80,7 @@ from tools.elastic_lint import (  # noqa: E402  (Finding must exist first)
     el004_thread_hygiene,
     el007_lifecycle,
     el009_span_hygiene,
+    el010_metric_registry,
     suppressions,
 )
 from tools.elastic_lint import (  # noqa: E402
@@ -92,6 +98,7 @@ RULES = (
     el004_thread_hygiene,
     el007_lifecycle,
     el009_span_hygiene,
+    el010_metric_registry,
 )
 
 PROGRAM_RULES = (

@@ -1,0 +1,165 @@
+"""EL010 — metric-registry conformance: every emitted ``elasticdl_*``
+Prometheus series name must be declared in
+``elasticdl_tpu/utils/metric_registry.py``.
+
+The failure mode this kills: a typo'd series name (``elasticdl_slo_okk``)
+ships silently — dashboards and alerts keyed on the intended name read
+"no data" forever, which on an observability plane is the worst kind of
+bug (invisible by construction).  With one declaration point, a rename
+is a two-line diff the lint can verify, the docs' metric tables can be
+cross-checked mechanically (tests/test_prom_exposition.py does), and a
+new series REQUIRES a one-line description before it can render.
+
+What the rule checks, per file:
+
+ - every Call whose callee is named ``prometheus_line`` or ``gauge``
+   (the renderers' local helper) with a literal first argument starting
+   with ``elasticdl_`` must be declared in the registry (``%s``
+   templates match declared names as ``[a-z0-9_]+``);
+ - every Call whose callee is named ``histogram_lines`` with a literal
+   SECOND argument (the metric) must be declared WITH
+   ``histogram=True`` — a histogram emitted under a gauge declaration
+   (or vice versa) is a finding;
+ - the registry itself must not declare a name twice (a duplicate dict
+   key would silently shadow — parsed from the AST, not the dict).
+
+Dynamic names (a variable first argument) are out of scope by design:
+the repo convention is literal names at call sites, and the exposition
+test catches anything that slips through at render time.
+"""
+
+import ast
+import os
+
+from tools.elastic_lint import Finding
+
+RULE_ID = "EL010"
+
+REGISTRY_REL = "elasticdl_tpu/utils/metric_registry.py"
+
+_registry_cache = {}
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_registry():
+    """Parse METRICS out of the registry module's AST (no import — the
+    lint must run without the package on sys.path), returning
+    ({name: histogram_bool}, [duplicate names]).  Cached per mtime."""
+    path = os.path.join(_repo_root(), REGISTRY_REL)
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}, []
+    cached = _registry_cache.get(path)
+    if cached and cached[0] == mtime:
+        return cached[1], cached[2]
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names = {}
+    duplicates = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "METRICS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for key, value in zip(node.value.keys, node.value.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)):
+                continue
+            if key.value in names:
+                duplicates.append(key.value)
+            histogram = False
+            if (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)):
+                histogram = value.func.id == "_H"
+            names[key.value] = histogram
+        break
+    _registry_cache[path] = (mtime, names, duplicates)
+    return names, duplicates
+
+
+def _is_declared(name, registry):
+    import re
+
+    if name in registry:
+        return True
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and registry.get(
+                name[: -len(suffix)]):
+            return True
+    if "%s" in name:
+        pattern = re.compile(
+            "^" + re.escape(name).replace("%s", "[a-z0-9_]+") + "$")
+        return any(pattern.match(known) for known in registry)
+    return False
+
+
+def _metric_literal(node):
+    """The literal string of a metric-name argument: a plain constant,
+    or the left side of a ``"..." % x`` template."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)):
+        return node.left.value
+    return None
+
+
+def _callee_name(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def check(tree, source, path):
+    findings = []
+    registry, duplicates = _load_registry()
+    norm = path.replace(os.sep, "/")
+    if norm.endswith(REGISTRY_REL):
+        for name in duplicates:
+            findings.append(Finding(
+                RULE_ID, path, 1, "METRICS.%s" % name,
+                "series %r declared more than once in the metric "
+                "registry" % name))
+        return findings
+    if not registry:
+        return findings  # registry missing: nothing to conform to
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_name(node.func)
+        if callee in ("prometheus_line", "gauge"):
+            arg_index = 0
+        elif callee == "histogram_lines":
+            arg_index = 1
+        else:
+            continue
+        if len(node.args) <= arg_index:
+            continue
+        name = _metric_literal(node.args[arg_index])
+        if name is None or not name.startswith("elasticdl_"):
+            continue
+        if not _is_declared(name, registry):
+            findings.append(Finding(
+                RULE_ID, path, node.lineno, name,
+                "series %r is not declared in %s (typo, or add a "
+                "one-line declaration)" % (name, REGISTRY_REL)))
+            continue
+        is_hist_call = callee == "histogram_lines"
+        declared_hist = registry.get(name, is_hist_call)
+        if is_hist_call != declared_hist and name in registry:
+            findings.append(Finding(
+                RULE_ID, path, node.lineno, name,
+                "series %r is declared %s but emitted %s"
+                % (name,
+                   "as a histogram" if declared_hist else "as a gauge",
+                   "as a histogram" if is_hist_call else "as a gauge")))
+    return findings
