@@ -71,6 +71,9 @@ os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 import numpy as np  # noqa: E402
 
+from elasticdl_tpu.utils import hist as hist_mod  # noqa: E402
+from elasticdl_tpu.utils import tensor_codec as tc  # noqa: E402
+
 FEATURES = 64
 HIDDEN = 128
 CLASSES = 8
@@ -113,22 +116,24 @@ def _export_mlp(export_dir):
     )
 
 
-def _payload(idx):
-    return {"instances": [[float((idx * 37 + j) % 23) / 23.0
-                           for j in range(FEATURES)]]}
+def _payload(idx, rows=1):
+    return {"instances": [[float((idx * 37 + r + j) % 23) / 23.0
+                           for j in range(FEATURES)]
+                          for r in range(rows)]}
 
 
 class _Rig:
     """One endpoint (+ HTTP server) per mode; collects best-block
     wall times and latency distributions per (layer, concurrency)."""
 
-    def __init__(self, export_dir, batching):
+    def __init__(self, export_dir, batching, payload_rows=1):
         from elasticdl_tpu.serving.server import (
             ModelEndpoint,
             build_server,
         )
 
         self.label = "batched" if batching is not None else "serialized"
+        self.payload_rows = payload_rows
         self.endpoint = ModelEndpoint(export_dir, batching=batching)
         self.server = build_server(self.endpoint, port=0)
         self.port = self.server.server_address[1]
@@ -162,7 +167,7 @@ class _Rig:
         errors = []
 
         def endpoint_client(idx):
-            body = _payload(idx)
+            body = _payload(idx, self.payload_rows)
             try:
                 self.endpoint.predict(body)  # unmeasured warm request
                 barrier.wait()
@@ -176,7 +181,7 @@ class _Rig:
                 barrier.abort()
 
         def http_client(idx):
-            body = json.dumps(_payload(idx))
+            body = json.dumps(_payload(idx, self.payload_rows))
             conn = http.client.HTTPConnection(
                 "127.0.0.1", self.port, timeout=120)
             try:
@@ -201,8 +206,44 @@ class _Rig:
             finally:
                 conn.close()
 
-        target = (endpoint_client if layer == "endpoint"
-                  else http_client)
+        def http_bin_client(idx):
+            # The binary wire path: frame in, frame out, over the
+            # SAME keep-alive connection discipline.  The client does
+            # the honest equivalent work of the JSON client — encode
+            # once, decode every response into typed arrays.
+            x = np.asarray(_payload(idx, self.payload_rows)
+                           ["instances"], np.float32)
+            body = tc.encode_frame({"instances": x}, kind="predict")
+            headers = {"Content-Type": tc.FRAME_CONTENT_TYPE}
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.port, timeout=120)
+            try:
+                conn.request("POST", "/v1/models/mlp:predict",
+                             body=body, headers=headers)
+                conn.getresponse().read()  # warm
+                barrier.wait()
+                for _ in range(requests_per_client):
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/v1/models/mlp:predict",
+                                 body=body, headers=headers)
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    if resp.status != 200:
+                        errors.append(raw[:200])
+                        return
+                    frame = tc.decode_frame(raw)
+                    tc.unflatten_tree(frame.meta["tree"],
+                                      frame.tensors)
+                    latencies[idx].append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+                barrier.abort()
+            finally:
+                conn.close()
+
+        target = {"endpoint": endpoint_client,
+                  "http": http_client,
+                  "http_bin": http_bin_client}[layer]
         threads = [threading.Thread(target=target, args=(i,),
                                     daemon=True)
                    for i in range(concurrency)]
@@ -688,6 +729,271 @@ def run_fleet_bench(requests_per_client=FLEET_REQUESTS_PER_CLIENT):
     return ratio, drill, lookup
 
 
+# -- binary wire leg (the zero-copy data plane) -------------------------
+
+WIRE_CONCURRENCY = 16       # the acceptance level (ROADMAP item 5)
+WIRE_APPROACH_FLOOR = 0.75  # e2e ratio must be >= 75% of endpoint's
+WIRE_P99_SLACK = 1.10       # binary p99 may not exceed json p99 by >10%
+# Requests carry a realistic ranking-candidate slate (the fleet leg's
+# 64-row shape), not one row — marshal cost scales with rows (the
+# whole point of the binary plane) while the per-request stdlib-HTTP
+# overhead (identical in both modes, the irreducible transport floor)
+# amortizes.  The batch cap fits 8 such requests per executed batch.
+WIRE_ROWS = 64
+WIRE_MAX_BATCH = 512
+
+
+def _hist_p99_ms(stats):
+    snap = (stats.get("hists") or {}).get("serving.request")
+    if not snap or not snap.get("count"):
+        return None
+    return round(1e3 * hist_mod.quantile(snap, 0.99), 3)
+
+
+def _run_router_passthrough(rig):
+    """One keyed binary request direct vs through the router: the
+    forwarded RESPONSE must be byte-identical (zero re-encode on the
+    proxied body; the request side's byte-identity is pinned with a
+    capturing replica in tests/test_serving_binary.py)."""
+    from elasticdl_tpu.serving.router import (
+        Router,
+        build_router_server,
+    )
+
+    x = np.asarray(_payload(5)["instances"], np.float32)
+    blob = tc.encode_frame({"instances": x}, kind="predict",
+                           routing_key="bench-key")
+    headers = {"Content-Type": tc.FRAME_CONTENT_TYPE}
+
+    def post(port):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        try:
+            conn.request("POST", "/v1/models/mlp:predict", body=blob,
+                         headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    router = Router(["127.0.0.1:%d" % rig.port], probe_interval=0.2)
+    router.start()
+    server = build_router_server(router, port=0)
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if router.state.routable(None):
+                break
+            time.sleep(0.05)
+        direct_status, direct = post(rig.port)
+        routed_status, routed = post(server.server_address[1])
+        return {
+            "direct_status": direct_status,
+            "routed_status": routed_status,
+            "byte_identical_response": bool(direct == routed),
+        }
+    finally:
+        router.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def _run_frame_transfer_leg(blocks=5):
+    """The streaming export/ingest sub-leg: ONE model payload through
+    the npz archive path (what every publish used to round-trip) vs
+    the binary model frame (encode -> decode as zero-copy views),
+    interleaved, best-of per mode."""
+    from elasticdl_tpu.serving.export import _npz_bytes, decode_payload
+
+    rng = np.random.RandomState(0)
+    payload = {"layer%02d/w" % i: rng.randn(256, 256)
+               .astype(np.float32) for i in range(16)}
+    payload["emb_ids/users"] = np.arange(20000, dtype=np.int64)
+    payload["emb_vals/users"] = rng.randn(20000, 32)\
+        .astype(np.float32)
+    nbytes = sum(a.nbytes for a in payload.values())
+
+    import io as _io
+
+    def npz_pass():
+        blob = _npz_bytes(payload)
+        with np.load(_io.BytesIO(blob)) as z:
+            dense, emb = decode_payload(
+                {key: z[key] for key in z.files})
+        return dense, emb
+
+    def frame_pass():
+        blob = tc.encode_frame(payload, kind="servable")
+        frame = tc.decode_frame(blob)
+        return decode_payload(dict(frame.tensors))
+
+    best = {"npz": float("inf"), "frame": float("inf")}
+    for block in range(blocks):
+        order = (("npz", npz_pass), ("frame", frame_pass)) \
+            if block % 2 == 0 else (("frame", frame_pass),
+                                    ("npz", npz_pass))
+        for name, fn in order:
+            t0 = time.perf_counter()
+            dense, emb = fn()
+            best[name] = min(best[name], time.perf_counter() - t0)
+    assert set(dense) and "users" in emb  # both paths decoded fully
+    return {
+        "payload_mb": round(nbytes / 1e6, 1),
+        "npz_roundtrip_ms": round(1e3 * best["npz"], 1),
+        "frame_roundtrip_ms": round(1e3 * best["frame"], 1),
+        "frame_speedup_x": round(best["npz"] / best["frame"], 2),
+    }
+
+
+def run_wire_bench(requests_per_client, max_batch_size,
+                   batch_timeout_ms, blocks=BLOCKS):
+    """The binary-plane acceptance leg: batched-vs-serialized ratios
+    at THREE layers (endpoint, http+JSON, http+binary) as interleaved
+    blocks, then the gates the ISSUE/ROADMAP name:
+
+      1. the binary e2e ratio at c=16 must be within 25% of the
+         endpoint-layer ratio (the JSON e2e ratio historically halved
+         it — that dilution is what this data plane removes);
+      2. binary server-side request p99 (the PR-13
+         ``serving.request`` histogram) must not exceed the JSON
+         path's by more than 10%;
+      3. JSON and binary responses bit-identical on the same model;
+      4. the router forwards binary bodies byte-identically.
+    """
+    conc = WIRE_CONCURRENCY
+    with tempfile.TemporaryDirectory() as tmp:
+        export_dir = os.path.join(tmp, "export")
+        _export_mlp(export_dir)
+        from elasticdl_tpu.serving.batcher import BatchConfig
+
+        serialized = _Rig(export_dir, None,
+                          payload_rows=WIRE_ROWS)
+        batched = _Rig(export_dir, BatchConfig(
+            max_batch_size=max_batch_size or WIRE_MAX_BATCH,
+            batch_timeout_ms=batch_timeout_ms),
+            payload_rows=WIRE_ROWS)
+        try:
+            # Bit-identity gate before any timing: JSON vs binary on
+            # the SAME batched server.
+            probe = _payload(3, WIRE_ROWS)
+            probe["instances"] = probe["instances"] * 3
+            want = np.asarray(batched.predict_http_once(probe),
+                              np.float32)
+            blob = tc.encode_frame(
+                {"instances": np.asarray(probe["instances"],
+                                         np.float32)},
+                kind="predict")
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              batched.port,
+                                              timeout=60)
+            conn.request("POST", "/v1/models/mlp:predict", body=blob,
+                         headers={"Content-Type":
+                                  tc.FRAME_CONTENT_TYPE})
+            resp = conn.getresponse()
+            frame = tc.decode_frame(resp.read())
+            conn.close()
+            got = tc.unflatten_tree(frame.meta["tree"], frame.tensors)
+            identical = bool(np.array_equal(want, got))
+            if not identical:
+                raise SystemExit("binary predictions differ from JSON")
+
+            # Interleaved blocks with leg-order alternation; the
+            # gate ratios come from each leg's BEST block (the PR-3
+            # idiom): container steal/scheduling noise is strictly
+            # one-sided (it only ever slows a leg), so best-of-N is
+            # the consistent estimator of each leg's capability —
+            # medians of the 16-threads-on-2-cores endpoint legs
+            # measured +/-30% run to run and made the cross-layer
+            # fraction a coin flip.  Per-block medians still ride in
+            # the detail for honesty.
+            results = []
+            layers = ("endpoint", "http", "http_bin")
+            block_ratios = {layer: [] for layer in layers}
+            for block in range(blocks):
+                legs = ((serialized, batched) if block % 2 == 0
+                        else (batched, serialized))
+                for layer in layers:
+                    wall = {}
+                    for rig in legs:
+                        wall[rig.label] = rig.timed_block(
+                            layer, conc, requests_per_client)
+                    block_ratios[layer].append(
+                        wall["serialized"] / wall["batched"])
+            medians = {}
+            for layer in layers:
+                ordered = sorted(block_ratios[layer])
+                medians[layer] = ordered[len(ordered) // 2]
+                results.append(serialized.result(
+                    layer, conc, requests_per_client))
+                results.append(batched.result(
+                    layer, conc, requests_per_client))
+            for r in results:
+                print(json.dumps(r))
+
+            def _best_ratio(layer):
+                return (serialized.best[(layer, conc)]
+                        / batched.best[(layer, conc)])
+
+            endpoint_ratio = _best_ratio("endpoint")
+            json_ratio = _best_ratio("http")
+            bin_ratio = _best_ratio("http_bin")
+            bin_fraction = bin_ratio / max(1e-9, endpoint_ratio)
+            json_fraction = json_ratio / max(1e-9, endpoint_ratio)
+            p99_json = _hist_p99_ms(
+                batched.counters[("http", conc)])
+            p99_bin = _hist_p99_ms(
+                batched.counters[("http_bin", conc)])
+            router_leg = _run_router_passthrough(batched)
+            transfer = _run_frame_transfer_leg()
+        finally:
+            serialized.close()
+            batched.close()
+
+    gates = {
+        "e2e_approaches_endpoint": bool(
+            bin_fraction >= WIRE_APPROACH_FLOOR),
+        "p99_within_slack": bool(
+            p99_json is not None and p99_bin is not None
+            and p99_bin <= p99_json * WIRE_P99_SLACK),
+        "bit_identical_responses": identical,
+        "router_byte_identical": bool(
+            router_leg["routed_status"] == 200
+            and router_leg["byte_identical_response"]),
+    }
+    print(json.dumps({
+        "metric": "serving_binary_plane",
+        "value": round(bin_fraction, 3),
+        "unit": "binary e2e ratio at c=%d as a fraction of the "
+                "endpoint-layer ratio (best-of-block legs; 1.0 = "
+                "zero transport dilution; gate >= %.2f)"
+                % (conc, WIRE_APPROACH_FLOOR),
+        "vs_baseline": round(json_fraction, 3),
+        "detail": {
+            "all_green": all(gates.values()),
+            "gates": gates,
+            "endpoint_ratio": round(endpoint_ratio, 2),
+            "json_e2e_ratio": round(json_ratio, 2),
+            "binary_e2e_ratio": round(bin_ratio, 2),
+            "median_block_ratios": {
+                layer: round(value, 2)
+                for layer, value in sorted(medians.items())},
+            "p99_ms_json_server_side": p99_json,
+            "p99_ms_binary_server_side": p99_bin,
+            "router_passthrough": router_leg,
+            "frame_transfer": transfer,
+            "concurrency": conc,
+            "baseline": "self-relative: the JSON http layer on the "
+                        "same rig IS the dilution baseline; "
+                        "endpoint-layer ratio is the transport-free "
+                        "ceiling (PR 3 measured JSON e2e at ~51% of "
+                        "it on this class of rig)",
+        },
+    }))
+    return gates
+
+
 def main(argv=None):
     import argparse
 
@@ -696,7 +1002,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser("bench_serving")
     parser.add_argument("--requests_per_client", type=int,
                         default=REQUESTS_PER_CLIENT)
-    parser.add_argument("--max_batch_size", type=int, default=MAX_BATCH)
+    parser.add_argument("--max_batch_size", type=int, default=None,
+                    help="batch cap; defaults to %d (default mode) or %d\n(--wire mode, sized for its 64-row slates)"
+                         % (MAX_BATCH, WIRE_MAX_BATCH))
     parser.add_argument("--batch_timeout_ms", type=float,
                         default=TIMEOUT_MS)
     parser.add_argument("--fleet", action="store_true",
@@ -704,10 +1012,31 @@ def main(argv=None):
                              "subprocesses behind the router, hot-swap "
                              "mid-storm, PS-backed lookup) instead of "
                              "the single-server batching comparison")
+    parser.add_argument("--wire", action="store_true",
+                        help="run the binary data-plane leg (JSON vs "
+                             "binary frames at c=16, p99 gate off the "
+                             "serving.request histogram, router "
+                             "pass-through byte-identity, npz-vs-"
+                             "frame transfer) instead of the batching "
+                             "comparison")
+    parser.add_argument("--blocks", type=int, default=BLOCKS)
     args = parser.parse_args(argv)
 
     if args.fleet:
         run_fleet_bench()
+        return
+
+    if args.wire:
+        if os.environ.get("ELASTICDL_TPU_PLATFORM"):
+            jax.config.update(
+                "jax_platforms",
+                os.environ["ELASTICDL_TPU_PLATFORM"])
+        gates = run_wire_bench(args.requests_per_client,
+                               args.max_batch_size,
+                               args.batch_timeout_ms,
+                               blocks=args.blocks)
+        if not all(gates.values()):
+            raise SystemExit("wire gates failed: %s" % gates)
         return
 
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
@@ -721,7 +1050,7 @@ def main(argv=None):
         _export_mlp(export_dir)
         serialized = _Rig(export_dir, None)
         batched = _Rig(export_dir, BatchConfig(
-            max_batch_size=args.max_batch_size,
+            max_batch_size=args.max_batch_size or MAX_BATCH,
             batch_timeout_ms=args.batch_timeout_ms))
         try:
             # Numerical identity gate before any timing.
@@ -782,7 +1111,8 @@ def main(argv=None):
                     "p99_ms_batched_endpoint": bat["p99_ms"],
                     "mean_batch_occupancy": bat[
                         "mean_batch_occupancy"],
-                    "max_batch_size": args.max_batch_size,
+                    "max_batch_size": args.max_batch_size
+                    or MAX_BATCH,
                     "batch_timeout_ms": args.batch_timeout_ms,
                     "baseline": "self-relative: the serialized "
                                 "execution-lock server IS the "

@@ -62,19 +62,37 @@ from elasticdl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _params_key(dense):
+    """Program-cache key: {name: (shape, dtype)} over the dense tree —
+    the StableHLO program depends on exactly this.  ONE definition:
+    the streamed-ingest cache write and the publish-time lookup must
+    never diverge."""
+    return {
+        name: (tuple(np.shape(leaf)), str(np.asarray(leaf).dtype))
+        for name, leaf in dense.items()
+    }
+
+
 class _Ingest:
-    """One ingested trainer export."""
+    """One ingested trainer export.
+
+    ``export_dir`` is None for a STREAMED ingest (``ingest_frame``):
+    its manifest rides on the ingest itself and the StableHLO program
+    arrives in-band (cached on the aggregator) instead of from
+    files."""
 
     __slots__ = ("version", "dense", "embeddings", "export_dir",
-                 "born_at")
+                 "born_at", "manifest", "program")
 
     def __init__(self, version, dense, embeddings, export_dir,
-                 born_at):
+                 born_at, manifest=None, program=None):
         self.version = version
         self.dense = dense
         self.embeddings = embeddings
         self.export_dir = export_dir
         self.born_at = born_at
+        self.manifest = manifest
+        self.program = program
 
 
 class ModelAggregator:
@@ -205,6 +223,46 @@ class ModelAggregator:
                 ingested.append(version)
                 self.bump("ingested")
         return ingested
+
+    def ingest_frame(self, blob, born_at=None):
+        """STREAMED ingest: one servable frame
+        (``serving.export.servable_frame_bytes`` /
+        ``ContinuousExporter.frame_bytes``) hands a trainer version to
+        this aggregator with no filesystem round-trip — the binary
+        wire format shared with the serving data plane
+        (docs/serving.md "Wire protocol"), decoded as zero-copy
+        views.  The same version-monotone rule as ``ingest_once``
+        applies: a stale (re-formed-world) frame is counted and
+        skipped, never ingested.  The frame's in-band StableHLO
+        program (present on first export / tree change) is cached for
+        publishing; a malformed frame raises
+        :class:`~elasticdl_tpu.utils.tensor_codec.FrameError` loudly.
+        Returns the ingested version, or None when skipped."""
+        from elasticdl_tpu.serving.export import servable_from_frame
+
+        dense, embeddings, manifest, program = servable_from_frame(
+            blob)
+        version = int(manifest.get("version", 0) or 0)
+        if version <= self._last_ingested:
+            self.bump("stale_exports_skipped")
+            return None
+        with tracing.span("agg.ingest", version=version,
+                          streamed=True):
+            if program is not None:
+                # Cache the in-band program AT INGEST: a priming frame
+                # superseded in the window before any publish must not
+                # take the program down with it.
+                self._program = program
+                self._program_params = _params_key(dense)
+            self._window.append(_Ingest(
+                version, dense, embeddings, None,
+                time.time() if born_at is None else born_at,
+                manifest=manifest, program=program))
+            self._last_ingested = version
+            self._ingested_set.add(version)
+            self.bump("ingested")
+            self.bump("ingested_frames")
+        return version
 
     # -- aggregate -----------------------------------------------------
 
@@ -348,15 +406,32 @@ class ModelAggregator:
         SHAPES/DTYPES (not the weight values), so it is read once and
         reused until the tree changes.  The cache key must carry
         shapes, not just names: a resized layer keeps its flat name
-        but needs the re-traced program its own export carries."""
+        but needs the re-traced program its own export carries.
+
+        Streamed ingests (``export_dir`` None) carry their manifest
+        in-band and their program exactly when the tree changed; a
+        stream that changed the tree WITHOUT shipping a program (a
+        restarted aggregator that missed the priming frame) fails
+        loudly here — the exporter re-primes with
+        ``frame_bytes(include_program=True)``."""
+        params_key = _params_key(ingest.dense)
+        if ingest.export_dir is None:
+            manifest = dict(ingest.manifest)
+            if ingest.program is not None:
+                self._program = ingest.program
+                self._program_params = params_key
+            elif (self._program is None
+                  or params_key != self._program_params):
+                raise RuntimeError(
+                    "streamed ingest of version %d carries no "
+                    "StableHLO program and none is cached for this "
+                    "parameter tree; re-send with "
+                    "frame_bytes(include_program=True)"
+                    % ingest.version)
+            return self._program, manifest
         with open(os.path.join(ingest.export_dir,
                                "manifest.json")) as f:
             manifest = json.load(f)
-        params_key = {
-            name: (tuple(np.shape(leaf)),
-                   str(np.asarray(leaf).dtype))
-            for name, leaf in ingest.dense.items()
-        }
         if self._program is None or params_key != self._program_params:
             with open(os.path.join(ingest.export_dir,
                                    "model.stablehlo"), "rb") as f:

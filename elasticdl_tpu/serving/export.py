@@ -30,6 +30,7 @@ import shutil
 
 import numpy as np
 
+from elasticdl_tpu.utils import tensor_codec
 from elasticdl_tpu.utils.logging import get_logger
 from elasticdl_tpu.utils.pytree import flatten_with_names, to_numpy
 
@@ -97,32 +98,49 @@ def _quantize_int8(flat, min_elems=QUANTIZE_MIN_ELEMS):
     return payload, quantized
 
 
-def load_payload(export_dir):
-    """(dense, embeddings) from an export's ``model.npz``, dequantizing
-    every encoding this framework writes — the framework-side decode
-    twin of the standalone loader (which carries its own copy BY
-    DESIGN: it must stay vendorable with zero framework imports).
-    Non-standalone callers (callbacks.load_export, tools) share THIS
-    one, so a new encoding is two coordinated edits, not four."""
+def decode_payload(payload):
+    """{npz-layout key: ndarray} -> (dense, embeddings), dequantizing
+    every encoding this framework writes.  The ONE payload decoder
+    behind both carriers — the npz archive and the binary servable
+    frame (``servable_from_frame``) — so a new encoding stays two
+    coordinated edits, not four."""
     dense = {}
     embeddings = {}
-    with np.load(os.path.join(export_dir, "model.npz")) as z:
-        for key in z.files:
-            if key.startswith("emb_ids/"):
-                name = key[len("emb_ids/"):]
-                if "emb_vals/" + name in z:
-                    values = z["emb_vals/" + name]
-                else:  # int8-quantized table
-                    values = (z["q8emb/" + name].astype(np.float32)
-                              * z["q8embscale/" + name])
-                embeddings[name] = (z[key], values)
-            elif key.startswith("q8/"):
-                name = key[len("q8/"):]
-                dense[name] = (z[key].astype(np.float32)
-                               * z["q8scale/" + name])
-            elif not key.startswith(("emb_vals/", "q8scale/",
-                                     "q8emb/", "q8embscale/")):
-                dense[key] = z[key]
+    for key, value in payload.items():
+        if key.startswith("emb_ids/"):
+            name = key[len("emb_ids/"):]
+            if "emb_vals/" + name in payload:
+                values = payload["emb_vals/" + name]
+            else:  # int8-quantized table
+                values = (payload["q8emb/" + name].astype(np.float32)
+                          * payload["q8embscale/" + name])
+            embeddings[name] = (value, values)
+        elif key.startswith("q8/"):
+            name = key[len("q8/"):]
+            dense[name] = (value.astype(np.float32)
+                           * payload["q8scale/" + name])
+        elif not key.startswith(("emb_vals/", "q8scale/",
+                                 "q8emb/", "q8embscale/")):
+            dense[key] = value
+    return dense, embeddings
+
+
+def load_payload(export_dir):
+    """(dense, embeddings) from an export dir — ``model.npz`` or the
+    binary ``model.frame`` (the streaming wire format; decoded as
+    zero-copy views over one file read) — the framework-side decode
+    twin of the standalone loader (which carries its own npz copy BY
+    DESIGN: it must stay vendorable with zero framework imports).
+    Non-standalone callers (callbacks.load_export, the aggregation
+    tier, tools) share THIS one."""
+    npz_path = os.path.join(export_dir, "model.npz")
+    if os.path.isfile(npz_path):
+        with np.load(npz_path) as z:
+            return decode_payload({key: z[key] for key in z.files})
+    frame_path = os.path.join(export_dir, "model.frame")
+    with open(frame_path, "rb") as f:
+        dense, embeddings, _manifest, _program = servable_from_frame(
+            f.read())
     return dense, embeddings
 
 
@@ -195,6 +213,52 @@ def _npz_bytes(payload):
     return buf.getvalue()
 
 
+# -- binary servable frames (the streaming export/ingest format) ----------
+
+SERVABLE_FRAME_KIND = "servable"
+_PROGRAM_TENSOR = "__program__"
+
+
+def servable_frame_bytes(payload, manifest, program=None):
+    """One servable snapshot as a single binary frame
+    (utils/tensor_codec; docs/serving.md "Wire protocol"): the npz
+    payload layout rides as named tensors, the manifest rides in the
+    frame header's meta, and — when the parameter tree is new to the
+    receiver — the StableHLO ``program`` bytes ride along as a uint8
+    tensor.  This is the streaming twin of an export DIRECTORY: the
+    trainer can hand a version to the aggregation tier (or a
+    ``model.frame`` file) without an npz zip round-trip, and the
+    receiver decodes it as zero-copy views."""
+    tensors = list(payload.items())
+    if program is not None:
+        tensors.append((_PROGRAM_TENSOR,
+                        np.frombuffer(program, np.uint8)))
+    return tensor_codec.encode_frame(
+        tensors, kind=SERVABLE_FRAME_KIND,
+        model_version=int(manifest.get("version", 0) or 0),
+        meta={"manifest": manifest})
+
+
+def servable_from_frame(data):
+    """-> (dense, embeddings, manifest, program_bytes_or_None).
+    Refuses any other frame kind or a frame without a manifest —
+    loudly, via :class:`tensor_codec.FrameError`."""
+    frame = tensor_codec.decode_frame(data)
+    if frame.kind != SERVABLE_FRAME_KIND:
+        raise tensor_codec.FrameError(
+            "not a servable frame (kind %r)" % frame.kind)
+    manifest = frame.meta.get("manifest")
+    if not isinstance(manifest, dict):
+        raise tensor_codec.FrameError(
+            "servable frame carries no manifest")
+    payload = dict(frame.tensors)
+    program = payload.pop(_PROGRAM_TENSOR, None)
+    if program is not None:
+        program = program.tobytes()
+    dense, embeddings = decode_payload(payload)
+    return dense, embeddings, manifest, program
+
+
 def _encode_embeddings(payload, embeddings, quantize):
     """Add embedding tables to a payload dict; returns (table names,
     emb-quantized manifest entries).  The ONE embedding encoder: the
@@ -223,37 +287,19 @@ def _encode_embeddings(payload, embeddings, quantize):
     return table_names, emb_quantized
 
 
-def export_servable(export_dir, apply_fn, params, example_input,
-                    model_name="", version=0, embeddings=None,
-                    dense_overrides=None, platforms=("cpu", "tpu"),
-                    polymorphic_batch=True, quantize=None):
-    """Write a standalone servable export.
-
-    apply_fn: (params_pytree, inputs) -> outputs (inference mode —
-    close over train=False before passing).  example_input: a pytree of
-    arrays fixing the serving signature (values are ignored, only
-    shape/dtype matter).  embeddings: {table: (ids, values)} from the
-    PS checkpoint merge.  dense_overrides: {flat_name: ndarray} taking
-    precedence over ``params`` (the PS checkpoint's newer dense state).
-
-    With ``polymorphic_batch`` (default) the leading dim of every input
-    leaf is exported SYMBOLIC, so the servable accepts any batch size —
-    a server can't fix its clients' batch at training time.  Falls back
-    to the example's fixed shapes if symbolic export fails (e.g. a
-    model whose lowering needs concrete dims).
-
-    ``quantize="int8"``: weights-only per-channel int8 storage for
-    large float matrices (~4x smaller artifact; the loader dequantizes
-    back to f32 at load time — see ``_quantize_int8``).
-    """
+def trace_servable(apply_fn, flat, treedef, example_input,
+                   platforms=("cpu", "tpu"), polymorphic_batch=True):
+    """Trace + serialize the serving program for an already-flattened
+    parameter dict.  Returns ``(program_bytes, poly, input_signature,
+    output_signature)`` — everything about an export that depends on
+    the MODEL FUNCTION and shapes, none of it on the weight values.
+    Shared by :func:`export_servable` (directory exports) and
+    :class:`ContinuousExporter` (which caches the result and reuses it
+    across checkpoint-cadence exports, on disk or as streaming
+    frames)."""
     import jax
     from jax import export as jax_export
 
-    params = to_numpy(params)
-    flat, treedef = flatten_with_names(params)
-    for name, value in (dense_overrides or {}).items():
-        if name in flat and np.shape(value) == np.shape(flat[name]):
-            flat[name] = np.asarray(value, flat[name].dtype)
     # Leaf order straight from the treedef (flatten_with_names preserves
     # it) — string-sorting the joined names would NOT reproduce it for
     # every name alphabet.
@@ -316,43 +362,21 @@ def export_servable(export_dir, apply_fn, params, example_input,
             jax.jit(serve_fn), platforms=list(platforms)
         )(flat_specs, input_specs)
 
-    quantized = []
-    if quantize == "int8":
-        payload, quantized = _quantize_int8(flat)
-    elif quantize:
-        raise ValueError("unknown quantize mode %r (only 'int8')"
-                         % (quantize,))
-    else:
-        payload = dict(flat)
-    table_names, emb_quantized = _encode_embeddings(
-        payload, embeddings, quantize)
     signature = _signature(example_input)
     if poly:
         # Truthful metadata: the leading dim is symbolic, not the
         # example's batch — record it as null.
-        import jax as _jax
-
         def _free_batch(spec):
             if isinstance(spec, dict) and "shape" in spec:
                 if spec["shape"]:
                     spec = dict(spec, shape=[None] + spec["shape"][1:])
             return spec
 
-        signature = _jax.tree_util.tree_map(
+        signature = jax.tree_util.tree_map(
             _free_batch, signature,
             is_leaf=lambda s: isinstance(s, dict) and "shape" in s,
         )
-    # A quantized export gets PREFIXED format tags: vendored loader
-    # copies that predate an encoding then reject it loudly at LOAD
-    # time instead of failing opaquely mid-init/predict.  Quantized
-    # embedding tables get their OWN prefix — a loader that knows
-    # int8-weights but not int8-emb must still refuse.
-    fmt = FORMAT
-    if quantized:
-        fmt = "int8-weights+" + fmt
-    if emb_quantized:
-        fmt = "int8-emb+" + fmt
-    quantized = quantized + emb_quantized  # manifest lists both kinds
+
     # Output signature straight from the exported avals (None where the
     # dim is symbolic): the serving batcher needs to know which OUTPUT
     # leaves carry the batch dim to slice a padded batch back per
@@ -378,11 +402,30 @@ def export_servable(export_dir, apply_fn, params, example_input,
         # back to its shape heuristic when the signature is absent.
         logger.warning("output signature not recorded: %s", e)
         output_signature = None
-    manifest = {
+    return exported.serialize(), poly, signature, output_signature
+
+
+def _manifest_for(model_name, version, flat, table_names, quantized,
+                  emb_quantized, poly, platforms, signature,
+                  output_signature):
+    """Assemble a truthful manifest from what was ACTUALLY written.
+
+    A quantized export gets PREFIXED format tags: vendored loader
+    copies that predate an encoding then reject it loudly at LOAD
+    time instead of failing opaquely mid-init/predict.  Quantized
+    embedding tables get their OWN prefix — a loader that knows
+    int8-weights but not int8-emb must still refuse."""
+    fmt = FORMAT
+    if quantized:
+        fmt = "int8-weights+" + fmt
+    if emb_quantized:
+        fmt = "int8-emb+" + fmt
+    return {
         "format": fmt,
         "model_name": model_name,
         "version": version,
-        "quantized_int8": sorted(quantized),
+        # manifest lists both kinds
+        "quantized_int8": sorted(quantized + emb_quantized),
         "polymorphic_batch": poly,
         "platforms": list(platforms),
         "parameters": sorted(flat),
@@ -391,9 +434,55 @@ def export_servable(export_dir, apply_fn, params, example_input,
         "output_signature": output_signature,
         "loader": "elasticdl_tpu.serving.loader:load_servable",
     }
+
+
+def export_servable(export_dir, apply_fn, params, example_input,
+                    model_name="", version=0, embeddings=None,
+                    dense_overrides=None, platforms=("cpu", "tpu"),
+                    polymorphic_batch=True, quantize=None):
+    """Write a standalone servable export.
+
+    apply_fn: (params_pytree, inputs) -> outputs (inference mode —
+    close over train=False before passing).  example_input: a pytree of
+    arrays fixing the serving signature (values are ignored, only
+    shape/dtype matter).  embeddings: {table: (ids, values)} from the
+    PS checkpoint merge.  dense_overrides: {flat_name: ndarray} taking
+    precedence over ``params`` (the PS checkpoint's newer dense state).
+
+    With ``polymorphic_batch`` (default) the leading dim of every input
+    leaf is exported SYMBOLIC, so the servable accepts any batch size —
+    a server can't fix its clients' batch at training time.  Falls back
+    to the example's fixed shapes if symbolic export fails (e.g. a
+    model whose lowering needs concrete dims).
+
+    ``quantize="int8"``: weights-only per-channel int8 storage for
+    large float matrices (~4x smaller artifact; the loader dequantizes
+    back to f32 at load time — see ``_quantize_int8``).
+    """
+    params = to_numpy(params)
+    flat, treedef = flatten_with_names(params)
+    for name, value in (dense_overrides or {}).items():
+        if name in flat and np.shape(value) == np.shape(flat[name]):
+            flat[name] = np.asarray(value, flat[name].dtype)
+    program, poly, signature, output_signature = trace_servable(
+        apply_fn, flat, treedef, example_input, platforms=platforms,
+        polymorphic_batch=polymorphic_batch)
+    quantized = []
+    if quantize == "int8":
+        payload, quantized = _quantize_int8(flat)
+    elif quantize:
+        raise ValueError("unknown quantize mode %r (only 'int8')"
+                         % (quantize,))
+    else:
+        payload = dict(flat)
+    table_names, emb_quantized = _encode_embeddings(
+        payload, embeddings, quantize)
+    manifest = _manifest_for(
+        model_name, version, flat, table_names, quantized,
+        emb_quantized, poly, platforms, signature, output_signature)
     publish_export(export_dir, {
         "model.npz": _npz_bytes(payload),
-        "model.stablehlo": exported.serialize(),
+        "model.stablehlo": program,
         "manifest.json": json.dumps(manifest, indent=2).encode(),
     })
     logger.info("servable export at %s (%d tensors, %d tables)",
@@ -419,27 +508,75 @@ class ContinuousExporter:
     """
 
     def __init__(self, export_base, model_name="",
-                 platforms=("cpu", "tpu"), quantize=None, keep=16):
+                 platforms=("cpu", "tpu"), quantize=None, keep=16,
+                 wire_format="npz"):
         """``keep``: source-base retention — after each export, only
         the newest ``keep`` versions remain (0 = keep everything).
         Continuous export mints versions indefinitely; the consumer
         (the aggregation tier) ingests promptly and tolerates GC'd
         versions, so a bounded source base trades completeness for
         not filling the trainer's disk.  Keep it comfortably above
-        the aggregator's window."""
+        the aggregator's window.
+
+        ``wire_format``: how the weights ride to the consumer —
+        ``"npz"`` (the default: a standard zip archive any loader
+        reads) or ``"frame"`` (the binary wire format,
+        docs/serving.md "Wire protocol": ``model.frame`` instead of
+        ``model.npz``, decoded by the aggregation tier as zero-copy
+        views over one file read, no zip container).  Frame exports
+        get a ``frame+`` format prefix so a standalone serving loader
+        refuses them loudly — the SOURCE base feeds the aggregator,
+        which re-publishes plain npz servables for the fleet."""
+        if wire_format not in ("npz", "frame"):
+            raise ValueError("wire_format must be 'npz' or 'frame', "
+                             "got %r" % (wire_format,))
         self.export_base = export_base
         self.model_name = model_name
         self.platforms = tuple(platforms)
         self.quantize = quantize
         self.keep = int(keep)
+        self.wire_format = wire_format
         self._program = None        # cached model.stablehlo bytes
-        self._manifest = None       # manifest template (dict)
         self._tree_key = None       # {name: (shape, dtype)} cache key
+        self._poly = None
+        self._signature = None
+        self._out_signature = None
         self.exports = 0
 
     def _key(self, flat):
         return {n: (tuple(np.shape(v)), str(np.asarray(v).dtype))
                 for n, v in flat.items()}
+
+    def _prepare(self, apply_fn, params, example_input, embeddings):
+        """The shared half of :meth:`export` and :meth:`frame_bytes`:
+        trace (or reuse) the program, encode the payload, assemble a
+        truthful manifest from what was ACTUALLY encoded.  Returns
+        (payload, manifest, program_is_fresh)."""
+        params = to_numpy(params)
+        flat, treedef = flatten_with_names(params)
+        key = self._key(flat)
+        fresh = self._program is None or key != self._tree_key
+        if fresh:
+            (self._program, self._poly, self._signature,
+             self._out_signature) = trace_servable(
+                apply_fn, flat, treedef, example_input,
+                platforms=self.platforms)
+            self._tree_key = key
+        quantized = []
+        if self.quantize == "int8":
+            payload, quantized = _quantize_int8(flat)
+        elif self.quantize:
+            raise ValueError("unknown quantize mode %r (only 'int8')"
+                             % (self.quantize,))
+        else:
+            payload = dict(flat)
+        table_names, emb_quantized = _encode_embeddings(
+            payload, embeddings, self.quantize)
+        manifest = _manifest_for(
+            self.model_name, 0, flat, table_names, quantized,
+            emb_quantized, self._poly, self.platforms,
+            self._signature, self._out_signature)
+        return payload, manifest, fresh
 
     def export(self, version, apply_fn, params, example_input,
                embeddings=None):
@@ -455,54 +592,52 @@ class ContinuousExporter:
                         "complete, skipped", version)
             with open(os.path.join(export_dir, "manifest.json")) as f:
                 return json.load(f)
-        params = to_numpy(params)
-        flat, _ = flatten_with_names(params)
-        key = self._key(flat)
-        if self._program is None or key != self._tree_key:
-            manifest = export_servable(
-                export_dir, apply_fn, params, example_input,
-                model_name=self.model_name, version=version,
-                embeddings=embeddings, platforms=self.platforms,
-                quantize=self.quantize,
-            )
-            with open(os.path.join(export_dir, "model.stablehlo"),
-                      "rb") as f:
-                self._program = f.read()
-            self._manifest = dict(manifest)
-            self._tree_key = key
+        payload, manifest, fresh = self._prepare(
+            apply_fn, params, example_input, embeddings)
+        manifest["version"] = version
+        if self.wire_format == "frame":
+            manifest["format"] = "frame+" + manifest["format"]
+            weights = {"model.frame":
+                       servable_frame_bytes(payload, manifest)}
         else:
-            quantized = []
-            if self.quantize == "int8":
-                payload, quantized = _quantize_int8(flat)
-            else:
-                payload = dict(flat)
-            # The SAME embedding encoder as the full export, and the
-            # manifest's format/quantized fields recomputed from what
-            # was actually written — a cached template must never
-            # describe encodings this payload does not carry.
-            table_names, emb_quantized = _encode_embeddings(
-                payload, embeddings, self.quantize)
-            fmt = self._manifest["format"].split("+")[-1]
-            if quantized:
-                fmt = "int8-weights+" + fmt
-            if emb_quantized:
-                fmt = "int8-emb+" + fmt
-            manifest = dict(
-                self._manifest, version=version, format=fmt,
-                quantized_int8=sorted(quantized + emb_quantized),
-                embedding_tables=sorted(table_names),
-            )
-            publish_export(export_dir, {
-                "model.npz": _npz_bytes(payload),
-                "model.stablehlo": self._program,
-                "manifest.json": json.dumps(manifest,
-                                            indent=2).encode(),
-            })
-            logger.info("continuous export: version %d at %s "
-                        "(program reused)", version, export_dir)
+            weights = {"model.npz": _npz_bytes(payload)}
+        publish_export(export_dir, {
+            **weights,
+            "model.stablehlo": self._program,
+            "manifest.json": json.dumps(manifest, indent=2).encode(),
+        })
+        logger.info("continuous export: version %d at %s (%s wire, "
+                    "program %s)", version, export_dir,
+                    self.wire_format,
+                    "traced" if fresh else "reused")
         self.exports += 1
         self._gc()
         return manifest
+
+    def frame_bytes(self, version, apply_fn, params, example_input,
+                    embeddings=None, include_program=None):
+        """One servable version as a STREAMING frame — no filesystem
+        at all: the trainer hands these bytes straight to an
+        aggregator's :meth:`~elasticdl_tpu.aggregation.aggregator.
+        ModelAggregator.ingest_frame` (in process or over a socket).
+        The StableHLO program rides inside the frame exactly when the
+        parameter tree is new to this exporter (first call / tree
+        change) — the streaming analog of the program-reuse disk
+        path, so steady state ships weights + manifest only.
+        ``include_program=True`` forces it along (re-priming a
+        receiver that restarted and lost its cache)."""
+        version = int(version)
+        payload, manifest, fresh = self._prepare(
+            apply_fn, params, example_input, embeddings)
+        manifest["version"] = version
+        manifest["format"] = "frame+" + manifest["format"]
+        if include_program is None:
+            include_program = fresh
+        blob = servable_frame_bytes(
+            payload, manifest,
+            program=self._program if include_program else None)
+        self.exports += 1
+        return blob
 
     def _gc(self):
         """Source-base retention: continuous export mints versions

@@ -7,14 +7,18 @@ router is a stdlib-only HTTP process (the same ThreadingHTTPServer
 discipline as the model server) that owns three jobs:
 
  - **Routing.**  Requests carrying a key (an ``X-Routing-Key`` header,
-   a ``routing_key`` JSON field, or — for ``:lookup`` — the embedding
+   the frame header's ``routing_key`` on binary bodies, a
+   ``routing_key`` JSON field, or — for ``:lookup`` — the embedding
    table name) are placed by RENDEZVOUS (highest-random-weight)
    hashing over the routable replicas: adding or removing a replica
    moves only ~1/N of the keyspace (tests pin this), which is what
    keeps the replicas' hot-row embedding caches warm through churn.
-   Keyless requests fall back to LEAST-LOADED: the router's own live
-   in-flight count per replica first (exact and instant), then the
-   probed queue-wait / occupancy from each replica's ``/statz``.
+   Binary bodies cost the router exactly one preamble+header read —
+   the payload is forwarded byte-identically, never parsed or
+   re-encoded (docs/serving.md "Wire protocol").  Keyless requests
+   fall back to LEAST-LOADED: the router's own live in-flight count
+   per replica first (exact and instant), then the probed queue-wait
+   / occupancy from each replica's ``/statz``.
 
  - **Health.**  A prober thread (serving/fleet.py) polls every
    replica's ``/statz``; a miss — or a failed live forward — EJECTS
@@ -57,6 +61,7 @@ from elasticdl_tpu.serving.fleet import (
     rendezvous_rank,
 )
 from elasticdl_tpu.utils import slo as slo_mod
+from elasticdl_tpu.utils import tensor_codec
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import build_router_parser
 from elasticdl_tpu.utils.hist import Histogram
@@ -559,13 +564,18 @@ class Router:
                 return "table:%s" % body["table"]
         return None
 
-    def forward(self, method, path, raw_body, key=None):
+    def forward(self, method, path, raw_body, key=None,
+                content_type=None):
         """Forward one request; returns (status, body_bytes,
-        content_type, replica_addr).  A transport-level failure ejects
-        the replica and retries on a survivor exactly once.  Replica
-        selection (``FleetState.acquire``) counts the forward in-flight
-        atomically with the pick, so concurrent keyless requests
-        spread instead of herding onto one momentarily-idle replica.
+        content_type, replica_addr).  ``content_type`` is the INBOUND
+        body's type, passed through to the replica verbatim — a binary
+        frame body is forwarded byte-identically, never re-encoded
+        (docs/serving.md "Wire protocol").  A transport-level failure
+        ejects the replica and retries on a survivor exactly once.
+        Replica selection (``FleetState.acquire``) counts the forward
+        in-flight atomically with the pick, so concurrent keyless
+        requests spread instead of herding onto one momentarily-idle
+        replica.
 
         With a canary active, keyed requests whose key falls on the
         canary slice of the ring (``canary_slice(key) < p``) route
@@ -593,9 +603,10 @@ class Router:
             else:
                 exclude_members = addrs
         start = time.monotonic()
-        status, body, content_type, addr = self._forward_pool(
+        status, body, resp_type, addr = self._forward_pool(
             method, path, raw_body, key, version_pin,
-            members=members, exclude_members=exclude_members)
+            members=members, exclude_members=exclude_members,
+            content_type=content_type)
         if cohort == "canary" and addr is None:
             # The whole canary pool died mid-canary: fall back to
             # baseline (the key regresses to the committed version —
@@ -606,9 +617,9 @@ class Router:
             self.state.bump("router.canary_fallback")
             cohort = "baseline"
             version_pin = self.committed_view
-            status, body, content_type, addr = self._forward_pool(
+            status, body, resp_type, addr = self._forward_pool(
                 method, path, raw_body, key, self.committed_view,
-                exclude_members=addrs)
+                exclude_members=addrs, content_type=content_type)
         elapsed = time.monotonic() - start
         self._note_cohort(
             cohort, keyed=key is not None,
@@ -622,7 +633,7 @@ class Router:
                 if h is None:
                     h = self._replica_lat[addr] = Histogram()
             h.observe(elapsed)
-        return status, body, content_type, addr
+        return status, body, resp_type, addr
 
     def _note_cohort(self, cohort, keyed, latency_ms, error, version):
         with self._cohort_lock:
@@ -647,7 +658,8 @@ class Router:
         return out
 
     def _forward_pool(self, method, path, raw_body, key, version_pin,
-                      members=None, exclude_members=()):
+                      members=None, exclude_members=(),
+                      content_type=None):
         """``version_pin`` is a CALLABLE evaluated per attempt (see
         forward(): the baseline pin must track a mid-request fleet
         flip)."""
@@ -681,7 +693,7 @@ class Router:
                 ).encode(), "application/json", None
             try:
                 result = self._forward_to(addr, method, path,
-                                          raw_body)
+                                          raw_body, content_type)
                 if (result[0] == 503 and attempts == 0
                         and b'"draining"' in result[1]):
                     # The replica refused ADMISSION (SIGTERM drain) —
@@ -714,7 +726,8 @@ class Router:
             finally:
                 self.state.forward_finished(addr)
 
-    def _forward_to(self, addr, method, path, raw_body):
+    def _forward_to(self, addr, method, path, raw_body,
+                    content_type=None):
         pool = self._pools.get(addr)
         if pool is None:
             # Raced a scale-down removal between acquire and here: a
@@ -725,7 +738,11 @@ class Router:
         try:
             headers = {}
             if raw_body is not None:
-                headers["Content-Type"] = "application/json"
+                # The INBOUND content type rides through: a binary
+                # frame stays a binary frame at the replica (no
+                # re-labeling, no re-encoding).
+                headers["Content-Type"] = (content_type
+                                           or "application/json")
             conn.request(method, path, body=raw_body, headers=headers)
             resp = conn.getresponse()
             payload = resp.read()
@@ -781,7 +798,11 @@ def build_router_server(router, port=0, host="127.0.0.1",
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"  # keep-alive toward clients,
         # same discipline (and Content-Length guarantee) as the model
-        # server's handler
+        # server's handler — including the anti-Nagle response-path
+        # settings (see serving/server.py: a header+body write pair
+        # on a keep-alive socket costs a 40 ms delayed-ACK stall).
+        disable_nagle_algorithm = True
+        wbufsize = -1
 
         def log_message(self, fmt, *args):
             logger.debug("router: " + fmt, *args)
@@ -833,12 +854,12 @@ def build_router_server(router, port=0, host="127.0.0.1",
                 return self._reply_json(
                     411, {"error": "Content-Length required"})
             length = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(length)
             if self.path.startswith("/fleet/"):
                 # Fleet-control plane (the aggregation tier's surface):
                 # executes on the rollout thread, bypasses the
                 # admission gate — a rollout command must be able to
                 # land WHILE the gate is closed for its own barrier.
+                raw = self.rfile.read(length)
                 try:
                     payload = json.loads(raw or b"{}")
                     return self._fleet_control(payload)
@@ -846,27 +867,89 @@ def build_router_server(router, port=0, host="127.0.0.1",
                     return self._reply_json(
                         400, {"error": "bad fleet command: %s" % e})
             if not self.path.startswith("/v1/"):
+                self.rfile.read(length)  # keep the connection framed
                 return self._reply_json(
                     404, {"error": "unknown path %r" % self.path})
-            key = None
-            if raw:
-                try:
-                    body = json.loads(raw)
-                except ValueError:
-                    body = None  # replica will 400 it; no key
-                key = Router.routing_key(self.path, self.headers,
-                                         body)
+            content_type = self.headers.get("Content-Type",
+                                            "application/json")
+            got = self._routed_body(length, content_type)
+            if got is None:
+                return  # malformed frame; already replied 400
+            key, raw = got
             # The version-flip barrier: requests admitted here are
             # drained before a fleet commit flips routing.
             if not router.gate.enter(timeout=gate_timeout):
                 return self._reply_json(
                     503, {"error": "fleet version flip in progress"})
             try:
-                status, payload, content_type, _ = router.forward(
-                    "POST", self.path, raw, key=key)
-                self._reply_raw(status, payload, content_type)
+                status, payload, resp_type, _ = router.forward(
+                    "POST", self.path, raw, key=key,
+                    content_type=content_type)
+                self._reply_raw(status, payload, resp_type)
             finally:
                 router.gate.exit_()
+
+        def _routed_body(self, length, content_type):
+            """(routing key, raw body) with the MINIMAL body
+            inspection the placement decision needs:
+
+             - an ``X-Routing-Key`` header costs ZERO body
+               inspection — the body is read once and forwarded;
+             - a binary frame costs exactly the preamble + header
+               read (``tensor_codec.read_frame_header``): the key is
+               in the frame header, the payload is read straight
+               through afterwards and spliced back verbatim — the
+               router never decodes, re-parses, or re-encodes a
+               tensor payload;
+             - only the JSON compatibility fallback still parses the
+               whole body (the ``routing_key`` field can be anywhere
+               in it).
+
+            Returns None after replying when a frame is malformed."""
+            explicit = self.headers.get("X-Routing-Key")
+            if explicit:
+                return explicit, self.rfile.read(length)
+            if tensor_codec.is_frame_content_type(content_type):
+                if length < tensor_codec.FRAME_PREAMBLE_SIZE:
+                    self.rfile.read(length)
+                    self._reply_json(
+                        400, {"error": "bad frame: body shorter than "
+                                       "the preamble"})
+                    return None
+                try:
+                    header, prefix, _payload_len = \
+                        tensor_codec.read_frame_header(
+                            self.rfile, limit=length)
+                except tensor_codec.FrameError as e:
+                    # The consumed byte count is ambiguous mid-error:
+                    # close instead of guessing at re-framing the
+                    # keep-alive stream.
+                    self.close_connection = True
+                    self._reply_json(400,
+                                     {"error": "bad frame: %s" % e})
+                    return None
+                rest = self.rfile.read(length - len(prefix))
+                key = header.get("routing_key")
+                if not key and self.path.endswith(":lookup"):
+                    # The SAME table-affinity key the JSON path
+                    # derives ("table:<name>"), so one table's hot
+                    # rows stay in ONE replica's embedding cache
+                    # regardless of the request's content type.
+                    meta = header.get("meta")
+                    table = (meta.get("table")
+                             if isinstance(meta, dict) else None)
+                    if table:
+                        key = "table:%s" % table
+                return key, prefix + rest
+            raw = self.rfile.read(length)
+            body = None
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    body = None  # replica will 400 it; no key
+            return Router.routing_key(self.path, self.headers,
+                                      body), raw
 
         def _fleet_control(self, payload):
             if self.path == "/fleet/rollout":

@@ -69,6 +69,7 @@ from elasticdl_tpu.serving.loader import (
     resolve_export_dir,
 )
 from elasticdl_tpu.utils import slo as slo_mod
+from elasticdl_tpu.utils import tensor_codec
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.args import build_serving_parser
 from elasticdl_tpu.utils.logging import get_logger
@@ -97,11 +98,24 @@ def _leaf_dtypes(signature):
 
 
 def _jsonable(outputs):
-    """Model output pytree (array | tuple | list | dict) -> JSON."""
+    """Model output pytree (array | tuple | list | dict) -> JSON.
+
+    ndarray leaves (the batcher hands back numpy views) marshal via ONE
+    direct ``.tolist()`` — no ``np.asarray`` re-wrap — and already-
+    plain scalars/strings pass through untouched instead of being
+    re-copied leaf-by-leaf through numpy; only a genuinely foreign
+    leaf (a live jax array on the serialized path) pays the one
+    ``np.asarray`` materialization."""
+    if isinstance(outputs, np.ndarray):
+        return outputs.tolist()
+    if isinstance(outputs, np.generic):
+        return outputs.item()
     if isinstance(outputs, dict):
         return {k: _jsonable(v) for k, v in outputs.items()}
     if isinstance(outputs, (list, tuple)):
         return [_jsonable(v) for v in outputs]
+    if outputs is None or isinstance(outputs, (bool, int, float, str)):
+        return outputs
     return np.asarray(outputs).tolist()
 
 
@@ -477,7 +491,8 @@ class ModelEndpoint:
                 counters.get("batcher.rows", 0) / batches
                 if batches else None),
             "hists": self.timing.histograms(
-                names=("batcher.queue_wait", "batcher.execute")),
+                names=("batcher.queue_wait", "batcher.execute",
+                       "serving.request")),
         }
         recent = self.timing.recent("batcher.queue_wait",
                                     self.RECENT_WINDOW_SECS)
@@ -508,17 +523,23 @@ class ModelEndpoint:
             }
         else:
             raise ValueError("body needs 'instances' or 'inputs'")
-        if self._batcher is not None:
-            outputs = self._batcher.predict(model, plan, inputs)
-        else:
-            with self._lock:
-                outputs = model.predict(inputs)
+        outputs = self._execute_predict(model, plan, inputs)
         # The version stamp is read from the SAME snapshot the request
         # executed against (batches never mix models), so the fleet
         # router's drills can assert version purity from responses.
         return {"predictions": _jsonable(outputs),
                 "model_version": int(model.manifest.get("version", 0)
                                      or 0)}
+
+    def _execute_predict(self, model, plan, inputs):
+        """ONE execution point for both content types: the batcher's
+        admission queue when batching is on, the serialized
+        execution-lock path (the documented off-switch behavior)
+        otherwise."""
+        if self._batcher is not None:
+            return self._batcher.predict(model, plan, inputs)
+        with self._lock:
+            return model.predict(inputs)
 
     def lookup(self, body):
         if self._batcher is None:
@@ -547,6 +568,103 @@ class ModelEndpoint:
             vectors = model.lookup_embedding(table, ids)
         return {"vectors": vectors.tolist(), "model_version": version,
                 "source": "export"}
+
+    # -- binary frame surface (docs/serving.md "Wire protocol") --------
+
+    @staticmethod
+    def _response_wire(frame):
+        """Per-request bf16 opt-in: ``meta.response_wire`` asks for the
+        RESPONSE payload in a reduced-precision wire dtype (the request
+        payload declares its own encoding per tensor)."""
+        wire = frame.meta.get("response_wire")
+        if wire is None:
+            return None
+        if wire not in tensor_codec.WIRE_DTYPES:
+            raise ValueError(
+                "response_wire %r not supported (one of %s)"
+                % (wire, list(tensor_codec.WIRE_DTYPES)))
+        return wire
+
+    @staticmethod
+    def _cast(arr, dtype_name_):
+        """The frame view is already a typed ndarray: pass it straight
+        through when the dtype matches (zero-copy into the batcher),
+        cast once when the manifest disagrees — never via Python
+        lists."""
+        want = np.dtype(dtype_name_)
+        return arr if arr.dtype == want else arr.astype(want)
+
+    def predict_frame(self, frame):
+        """Binary ``:predict``: inputs come in as zero-copy frame
+        views ({"instances": x} for array-input models, one named
+        tensor per leaf for dict-input models) and go into the SAME
+        batcher admission queue as JSON requests — coalescing, version
+        purity, and hot-swap discipline are content-type-blind.
+        Returns the encoded response frame (kind "predictions", the
+        output pytree flattened with its tree spec in meta)."""
+        if self._batcher is None:
+            self.maybe_reload()
+        model, dtypes, plan = self._snapshot()
+        tensors = frame.tensors
+        if not tensors:
+            raise ValueError("predict frame carries no tensors")
+        if None in dtypes:
+            # Array-input model (leaf signature): exactly one tensor,
+            # named "instances" (the JSON body's key).  The MODEL's
+            # signature decides the marshal shape — a dict-input model
+            # may legitimately have an input leaf named "instances".
+            if set(tensors) != {"instances"}:
+                raise ValueError(
+                    "array-input model expects exactly one "
+                    "'instances' tensor, got %s" % sorted(tensors))
+            inputs = self._cast(tensors["instances"],
+                                dtypes.get(None, "float32"))
+        else:
+            inputs = {
+                key: self._cast(arr, dtypes.get(key, "float32"))
+                for key, arr in tensors.items()
+            }
+        outputs = self._execute_predict(model, plan, inputs)
+        out_tensors, spec = tensor_codec.flatten_tree(outputs,
+                                                      prefix="p")
+        return tensor_codec.encode_frame(
+            out_tensors, kind="predictions",
+            model_version=int(model.manifest.get("version", 0) or 0),
+            wire_dtype=self._response_wire(frame),
+            meta={"tree": spec})
+
+    def lookup_frame(self, frame):
+        """Binary ``:lookup``: ids ride as one int64 tensor, the table
+        name in meta; vectors come back as one tensor — no row lists
+        in either direction.  PS-backed tables resolve exactly as on
+        the JSON path."""
+        if self._batcher is None:
+            self.maybe_reload()
+        model = self._snapshot()[0]
+        table = frame.meta.get("table")
+        if not table:
+            raise ValueError("lookup frame needs meta.table")
+        ids_view = frame.tensors.get("ids")
+        if ids_view is None:
+            raise ValueError("lookup frame needs an 'ids' tensor")
+        ids = self._cast(ids_view, "int64")
+        version = int(model.manifest.get("version", 0) or 0)
+        wire = self._response_wire(frame)
+        if self._embedding_service is not None and (
+                frame.meta.get("source") == "ps"
+                or table not in model.embeddings):
+            vectors = self._embedding_service.lookup(table, ids)
+            source = "ps"
+        elif self._batcher is not None:
+            vectors = self._batcher.lookup(model, table, ids)
+            source = "export"
+        else:
+            vectors = model.lookup_embedding(table, ids)
+            source = "export"
+        return tensor_codec.encode_frame(
+            {"vectors": vectors}, kind="vectors",
+            model_version=version, wire_dtype=wire,
+            meta={"source": source})
 
 
 class DrainController:
@@ -649,7 +767,9 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
             % sorted(e.name for e in endpoints))
     drain = drain if drain is not None else DrainController()
 
-    # Routing tables built ONCE: O(1) dispatch per request.
+    # Routing tables built ONCE: O(1) dispatch per request.  POST
+    # routes carry (endpoint, json handler, frame handler): the same
+    # path serves both content types, negotiated per request.
     get_paths = {}
     post_routes = {}
     for name, endpoint in by_name.items():
@@ -658,8 +778,10 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
         # alias so their request shape carries over.
         get_paths[base] = endpoint.metadata
         get_paths[base + "/metadata"] = endpoint.metadata
-        post_routes[base + ":predict"] = endpoint.predict
-        post_routes[base + ":lookup"] = endpoint.lookup
+        post_routes[base + ":predict"] = (
+            endpoint, endpoint.predict, endpoint.predict_frame)
+        post_routes[base + ":lookup"] = (
+            endpoint, endpoint.lookup, endpoint.lookup_frame)
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 => persistent connections: without this every
@@ -668,6 +790,16 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
         # real clients and pollutes benchmarks.  Safe here because
         # _reply ALWAYS sets Content-Length, including error replies.
         protocol_version = "HTTP/1.1"
+        # Kill the Nagle/delayed-ACK interaction on the response path:
+        # the stdlib handler writes the header block and the body as
+        # SEPARATE sends, and on keep-alive connections the second
+        # small segment sits behind the peer's delayed ACK — measured
+        # 44 ms per request on this kernel, i.e. the entire serving
+        # latency budget.  TCP_NODELAY plus a buffered wfile (one
+        # segment per response, flushed by handle_one_request) makes a
+        # small predict ~0.8 ms end-to-end.
+        disable_nagle_algorithm = True
+        wbufsize = -1
 
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("http: " + fmt, *args)
@@ -757,6 +889,13 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
             self._reply(404, {"error": "unknown path %r (models: %s)"
                               % (self.path, sorted(by_name))})
 
+        def _reply_bytes(self, code, blob, content_type):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
         def do_POST(self):
             if self.headers.get("Transfer-Encoding") or (
                     "Content-Length" not in self.headers):
@@ -769,12 +908,28 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
                     411, {"error": "Content-Length required "
                                    "(chunked bodies unsupported)"})
             length = int(self.headers.get("Content-Length", 0))
-            try:
-                # ValueError covers JSONDecodeError AND the
-                # UnicodeDecodeError a non-UTF-8 body raises.
-                body = json.loads(self.rfile.read(length) or b"{}")
-            except ValueError as e:
-                return self._reply(400, {"error": "bad JSON: %s" % e})
+            raw = self.rfile.read(length)
+            # Content-type negotiation (docs/serving.md "Wire
+            # protocol"): the binary frame content type takes the
+            # zero-copy path; anything else is the JSON compatibility
+            # fallback.  Errors are ALWAYS JSON, whatever came in.
+            binary = tensor_codec.is_frame_content_type(
+                self.headers.get("Content-Type"))
+            frame = body = None
+            if binary:
+                try:
+                    frame = tensor_codec.decode_frame(raw)
+                except tensor_codec.FrameError as e:
+                    return self._reply(400, {"error": "bad frame: %s"
+                                             % e})
+            else:
+                try:
+                    # ValueError covers JSONDecodeError AND the
+                    # UnicodeDecodeError a non-UTF-8 body raises.
+                    body = json.loads(raw or b"{}")
+                except ValueError as e:
+                    return self._reply(400,
+                                       {"error": "bad JSON: %s" % e})
             if not drain.admit():
                 # Draining: refuse + close so the client's next request
                 # opens against a healthy replica (the router also
@@ -799,7 +954,23 @@ def build_server(endpoints, port=0, host="127.0.0.1", drain=None):
                     return self._reply(
                         404, {"error": "unknown path %r (models: %s)"
                               % (self.path, sorted(by_name))})
-                self._reply(200, route(body))
+                endpoint, json_fn, frame_fn = route
+                # Server-side request latency (marshal + queue +
+                # execute + RESPONSE ENCODE — json.dumps runs inside
+                # the window on the JSON path so both content types
+                # measure the same span) as a PR-13 histogram — the
+                # p99 the bench gate and /metrics read.  Local start:
+                # handler threads run concurrently.
+                t0 = time.monotonic()
+                if binary:
+                    blob = frame_fn(frame)
+                    content_type = tensor_codec.FRAME_CONTENT_TYPE
+                else:
+                    blob = json.dumps(json_fn(body)).encode()
+                    content_type = "application/json"
+                endpoint.timing.observe("serving.request",
+                                        time.monotonic() - t0)
+                return self._reply_bytes(200, blob, content_type)
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — runtime failures

@@ -84,7 +84,16 @@ def add_common_args(parser):
                              "same guard as checkpointing.  The "
                              "StableHLO program is traced once and "
                              "reused, so steady-state export cost is "
-                             "one weight gather + npz write")
+                             "one weight gather + one weights write")
+    parser.add_argument("--export_wire", default="npz",
+                        choices=("npz", "frame"),
+                        help="continuous-export weight carrier: 'npz' "
+                             "(standard archive, any loader) or "
+                             "'frame' (the binary tensor wire format, "
+                             "docs/serving.md 'Wire protocol': the "
+                             "aggregation tier decodes model.frame as "
+                             "zero-copy views — no zip container on "
+                             "the export/ingest hot path)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--profile_dir", default="",
                         help="write a JAX/XLA xplane trace of the worker "

@@ -118,6 +118,9 @@ METRICS = {
         "admission-queue wait distribution {model=}"),
     "elasticdl_serving_execute_seconds": _H(
         "device-batch execute distribution {model=}"),
+    "elasticdl_serving_request_seconds": _H(
+        "server-side request wall time (marshal+queue+execute+encode, "
+        "JSON and binary content types) {model=}"),
     "elasticdl_serving_emb_cache_bytes": _G(
         "hot-row cache bytes {model=}"),
     "elasticdl_serving_emb_cache_rows": _G(

@@ -284,7 +284,14 @@ def serving_to_prometheus(status):
                 ("batcher.queue_wait",
                  "elasticdl_serving_queue_wait_seconds"),
                 ("batcher.execute",
-                 "elasticdl_serving_execute_seconds")):
+                 "elasticdl_serving_execute_seconds"),
+                # Server-side request wall time (marshal + queue +
+                # execute + encode), observed per request in the HTTP
+                # handler for BOTH content types — the p99 the binary
+                # data plane's bench gate reads (docs/serving.md
+                # "Wire protocol").
+                ("serving.request",
+                 "elasticdl_serving_request_seconds")):
             if hists.get(phase):
                 histogram_lines(lines, metric, hists[phase],
                                 model=name)

@@ -83,7 +83,8 @@ def _build_collective_trainer(args, mc, spec, worker_id,
             from elasticdl_tpu.serving.export import ContinuousExporter
 
             exporter = ContinuousExporter(
-                args.export_base, model_name=args.job_name
+                args.export_base, model_name=args.job_name,
+                wire_format=getattr(args, "export_wire", "npz"),
             )
     trainer = CollectiveTrainer(
         spec,

@@ -14,6 +14,10 @@ Runs, in order, each in a fresh subprocess with the CPU platform pinned:
   5. one bench.py pass (CPU; validates the JSON contract end-to-end)
   6. bench_tracing.py with BOTH overhead gates (tracing <= 2%,
      histogram path <= 2% steps/s)
+  7. bench_serving.py --wire: the binary serving data plane's gates
+     (e2e ratio within 25% of the endpoint-layer ratio, binary p99
+     within 10% of JSON's, JSON-vs-binary bit-identity, router
+     byte-identical pass-through)
 
 Exits nonzero on the FIRST failure with the failing stage named.  Run it
 before every end-of-round snapshot — round 2 shipped a broken HEAD
@@ -153,6 +157,31 @@ def main(argv=None):
             return 1
         print("[preflight] overhead ratios: tracing %s, histogram %s"
               % (parsed["value"], hist_leg.get("steps_ratio")))
+
+        # Binary serving data plane (ISSUE 15): the e2e-approaches-
+        # endpoint ratio gate, the serving.request p99 gate, JSON-vs-
+        # binary bit-identity, and router byte-identical pass-through
+        # — bench_serving.py --wire exits nonzero itself when any gate
+        # fails; the detail check below keeps the verdict visible.
+        ok, out = run_stage(
+            "bench_serving.py --wire (binary-plane gates)",
+            [sys.executable, "bench_serving.py", "--wire",
+             "--requests_per_client", "30", "--blocks", "4"],
+            timeout=900,
+        )
+        if not ok:
+            return 1
+        parsed = last_json_line(out)
+        detail = (parsed or {}).get("detail", {})
+        if not detail.get("all_green"):
+            print("[preflight] FAIL bench_serving --wire: gates %s"
+                  % detail.get("gates"))
+            return 1
+        print("[preflight] binary plane: e2e/endpoint %s (json %s), "
+              "p99 %s vs %s ms"
+              % (parsed.get("value"), parsed.get("vs_baseline"),
+                 detail.get("p99_ms_binary_server_side"),
+                 detail.get("p99_ms_json_server_side")))
 
     print("[preflight] ALL GREEN")
     return 0

@@ -343,3 +343,100 @@ def test_bad_mode_and_decay_refused(tmp_path):
         ModelAggregator(str(tmp_path), str(tmp_path), mode="median")
     with pytest.raises(ValueError, match="ema_decay"):
         ModelAggregator(str(tmp_path), str(tmp_path), ema_decay=1.5)
+
+
+# -- streaming frames (the binary wire format, docs/serving.md) -----------
+
+
+def test_frame_wire_source_ingests_and_publishes(tmp_path):
+    """ContinuousExporter(wire_format="frame") writes model.frame
+    instead of model.npz; the aggregator ingests it through the same
+    loop and publishes a plain npz servable the fleet loader reads —
+    while the standalone loader refuses the frame-format SOURCE dir
+    loudly (it is the aggregator's input, not a servable)."""
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = ContinuousExporter(str(src), model_name="lin",
+                            platforms=("cpu",), wire_format="frame")
+    for version, value in ((1, 1.0), (2, 3.0)):
+        ce.export(version, _apply,
+                  {"w": np.full((4, 2), value, np.float32)},
+                  np.zeros((1, 4), np.float32))
+    assert os.path.isfile(str(src / "1" / "model.frame"))
+    assert not os.path.exists(str(src / "1" / "model.npz"))
+    with open(str(src / "2" / "manifest.json")) as f:
+        assert json.load(f)["format"].startswith("frame+")
+    with pytest.raises(ValueError, match="format"):
+        load_servable(str(src / "2"))
+    agg = ModelAggregator(str(src), str(pub), window=2, mode="mean")
+    assert agg.ingest_once() == [1, 2]
+    version, _ = agg.publish()
+    assert version == 2
+    assert _published_value(pub / "2") == pytest.approx(2.0)
+
+
+def test_streamed_frame_ingest_no_filesystem(tmp_path):
+    """frame_bytes -> ingest_frame: a trainer version reaches the
+    aggregator with no export directory at all.  The program rides
+    in-band on the first frame only; stale frames skip monotonically;
+    the publish is byte-compatible with the file path."""
+    from elasticdl_tpu.serving.export import servable_from_frame
+
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(tmp_path / "unused")
+    agg = ModelAggregator(str(src), str(pub), window=2, mode="latest")
+
+    def frame(version, value, **kw):
+        return ce.frame_bytes(
+            version, _apply,
+            {"w": np.full((4, 2), value, np.float32)},
+            np.zeros((1, 4), np.float32), **kw)
+
+    first = frame(1, 1.0)
+    assert servable_from_frame(first)[3] is not None  # program rides
+    steady = frame(2, 2.0)
+    assert servable_from_frame(steady)[3] is None     # weights only
+    assert agg.ingest_frame(first) == 1
+    assert agg.ingest_frame(steady) == 2
+    assert agg.ingest_frame(first) is None            # stale: skipped
+    stats = agg.stats()
+    assert stats["counters"]["stale_exports_skipped"] == 1
+    assert stats["counters"]["ingested_frames"] == 2
+    version, _ = agg.publish()
+    assert version == 2
+    assert _published_value(pub / "2") == pytest.approx(2.0)
+
+
+def test_streamed_tree_change_without_program_fails_loudly(tmp_path):
+    src, pub = tmp_path / "src", tmp_path / "pub"
+    ce = _exporter(tmp_path / "unused")
+    agg = ModelAggregator(str(src), str(pub), window=1,
+                          mode="latest")
+    agg.ingest_frame(ce.frame_bytes(
+        1, _apply, {"w": np.full((4, 2), 1.0, np.float32)},
+        np.zeros((1, 4), np.float32)))
+    agg.publish()
+    # A NEW tree whose priming frame was suppressed: the publish must
+    # refuse instead of serving the old program with new weights.
+    blob = ce.frame_bytes(
+        2, lambda p, x: x @ p["w2"],
+        {"w2": np.full((4, 3), 1.0, np.float32)},
+        np.zeros((1, 4), np.float32), include_program=False)
+    agg.ingest_frame(blob)
+    with pytest.raises(RuntimeError, match="include_program"):
+        agg.publish()
+    # Re-priming with the program recovers.
+    agg2 = ModelAggregator(str(src), str(pub), window=1,
+                           mode="latest")
+    agg2.ingest_frame(ce.frame_bytes(
+        3, lambda p, x: x @ p["w2"],
+        {"w2": np.full((4, 3), 1.0, np.float32)},
+        np.zeros((1, 4), np.float32), include_program=True))
+    version, _ = agg2.publish()
+    model = load_servable(str(pub / "3"))
+    assert np.asarray(
+        model.predict(np.ones((1, 4), np.float32))).shape == (1, 3)
+
+
+def test_exporter_wire_format_validation(tmp_path):
+    with pytest.raises(ValueError, match="wire_format"):
+        ContinuousExporter(str(tmp_path), wire_format="zip")

@@ -1,0 +1,307 @@
+"""Binary frame codec (utils/tensor_codec, docs/serving.md "Wire
+protocol"): bit-exact round-trips across dtypes, zero-copy receive
+views, odd/zero-length shapes, header-only stream reads, and LOUD
+refusal of truncated/garbage frames — a malformed frame must raise
+immediately, never hang a reader."""
+
+import io
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.utils import tensor_codec as tc
+
+
+def _rt(tensors, **kw):
+    return tc.decode_frame(tc.encode_frame(tensors, **kw))
+
+
+# -- round-trips ----------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int64",
+                                   "int32", "uint8", "bool"])
+def test_roundtrip_bit_exact_per_dtype(dtype):
+    rng = np.random.RandomState(3)
+    arr = (rng.randn(5, 7) * 100).astype(dtype)
+    out = _rt({"x": arr}).tensors["x"]
+    assert out.dtype == np.dtype(dtype)
+    assert out.shape == arr.shape
+    assert np.array_equal(out, arr)
+    # Bit-exact, not just value-equal.
+    assert out.tobytes() == arr.tobytes()
+
+
+def test_roundtrip_bf16_wire_upcasts_to_f32():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    arr = np.linspace(-3, 3, 24, dtype=np.float32).reshape(4, 6)
+    blob = tc.encode_frame({"x": arr}, wire_dtype="bfloat16")
+    out = tc.decode_frame(blob).tensors["x"]
+    assert out.dtype == np.float32
+    want = arr.astype(ml_dtypes.bfloat16).astype(np.float32)
+    assert np.array_equal(out, want)
+    # Half the payload bytes vs the f32 encoding.
+    assert len(blob) < len(tc.encode_frame({"x": arr}))
+
+
+def test_wire_dtype_only_compresses_float32():
+    ids = np.arange(9, dtype=np.int64)
+    f64 = np.ones(4, np.float64)
+    frame = _rt({"ids": ids, "f64": f64}, wire_dtype="bfloat16")
+    assert frame.tensors["ids"].dtype == np.int64
+    assert np.array_equal(frame.tensors["ids"], ids)
+    assert frame.tensors["f64"].dtype == np.float64
+
+
+@pytest.mark.parametrize("shape", [(), (1,), (0,), (0, 7), (3, 0, 2),
+                                   (1, 1, 1)])
+def test_odd_and_zero_length_shapes(shape):
+    arr = np.zeros(shape, np.float32) + 2.5
+    out = _rt({"x": arr}).tensors["x"]
+    assert out.shape == shape
+    assert np.array_equal(out, arr)
+
+
+def test_non_contiguous_input_encodes_correctly():
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    sliced = base[:, ::2]           # non-contiguous view
+    out = _rt({"x": sliced}).tensors["x"]
+    assert np.array_equal(out, sliced)
+
+
+def test_receive_views_are_zero_copy():
+    arr = np.arange(64, dtype=np.float32)
+    blob = tc.encode_frame({"x": arr})
+    frame = tc.decode_frame(blob)
+    view = frame.tensors["x"]
+    # A view over the frame buffer, not a copy (the tentpole claim).
+    assert not view.flags.owndata
+    assert np.shares_memory(
+        view, np.frombuffer(blob, np.uint8))
+    # 8-byte aligned offsets: safe typed views for every dtype used.
+    assert all(e["offset"] % tc.FRAME_ALIGN == 0
+               for e in json.loads(_header_bytes(blob))["tensors"])
+
+
+def test_header_fields_roundtrip():
+    frame = _rt({"x": np.zeros(1, np.float32)}, kind="predict",
+                model_version=41, routing_key="user-9",
+                meta={"response_wire": "bfloat16"})
+    assert frame.kind == "predict"
+    assert frame.model_version == 41
+    assert frame.routing_key == "user-9"
+    assert frame.meta["response_wire"] == "bfloat16"
+    # Tensor order preserved (insertion order of the dict).
+    multi = _rt([("b", np.zeros(1)), ("a", np.ones(1))])
+    assert list(multi.tensors) == ["b", "a"]
+
+
+def test_content_type_predicate():
+    assert tc.is_frame_content_type(tc.FRAME_CONTENT_TYPE)
+    assert tc.is_frame_content_type(
+        tc.FRAME_CONTENT_TYPE + "; charset=binary")
+    assert not tc.is_frame_content_type("application/json")
+    assert not tc.is_frame_content_type(None)
+    assert not tc.is_frame_content_type("")
+
+
+# -- refusal: truncation and garbage --------------------------------------
+
+def _header_bytes(blob):
+    _, hlen, _ = struct.unpack_from("<4sIQ", blob)
+    return blob[tc.FRAME_PREAMBLE_SIZE:tc.FRAME_PREAMBLE_SIZE + hlen]
+
+
+def _good_blob():
+    return tc.encode_frame({"x": np.arange(6, dtype=np.float32),
+                            "y": np.arange(4, dtype=np.int64)},
+                           kind="predict", routing_key="k")
+
+
+def test_truncation_refused_at_every_boundary():
+    blob = _good_blob()
+    # Mid-preamble, exactly-preamble, mid-header, mid-payload, one
+    # byte short: every cut raises, none hangs or mis-decodes.
+    for cut in (0, 7, tc.FRAME_PREAMBLE_SIZE,
+                tc.FRAME_PREAMBLE_SIZE + 3, len(blob) - 1):
+        with pytest.raises(tc.FrameError):
+            tc.decode_frame(blob[:cut])
+
+
+def test_trailing_garbage_refused():
+    with pytest.raises(tc.FrameError, match="trailing|truncated"):
+        tc.decode_frame(_good_blob() + b"x")
+
+
+def test_garbage_magic_refused():
+    blob = _good_blob()
+    with pytest.raises(tc.FrameError, match="magic"):
+        tc.decode_frame(b"NOPE" + blob[4:])
+
+
+def test_absurd_header_length_refused():
+    bad = struct.pack("<4sIQ", tc.FRAME_MAGIC,
+                      tc.FRAME_HEADER_MAX + 1, 0)
+    with pytest.raises(tc.FrameError, match="header length"):
+        tc.decode_frame(bad)
+
+
+def test_non_json_header_refused():
+    payload = b""
+    header = b"\xff\xfe not json"
+    blob = struct.pack("<4sIQ", tc.FRAME_MAGIC, len(header),
+                       len(payload)) + header + payload
+    with pytest.raises(tc.FrameError, match="JSON"):
+        tc.decode_frame(blob)
+
+
+def _frame_with_entry(entry, payload=b"\x00" * 64):
+    header = json.dumps({"kind": "t", "model_version": 0,
+                         "tensors": [entry]}).encode()
+    return (struct.pack("<4sIQ", tc.FRAME_MAGIC, len(header),
+                        len(payload)) + header + payload)
+
+
+def test_tensor_table_out_of_bounds_refused():
+    for entry in (
+        # nbytes does not match shape*itemsize
+        {"name": "x", "dtype": "float32", "shape": [4], "offset": 0,
+         "nbytes": 12},
+        # runs past the payload
+        {"name": "x", "dtype": "float32", "shape": [32], "offset": 8,
+         "nbytes": 128},
+        # negative offset
+        {"name": "x", "dtype": "float32", "shape": [2], "offset": -8,
+         "nbytes": 8},
+        # negative dim
+        {"name": "x", "dtype": "float32", "shape": [-1, 4],
+         "offset": 0, "nbytes": 16},
+        # unknown dtype
+        {"name": "x", "dtype": "notadtype", "shape": [2], "offset": 0,
+         "nbytes": 8},
+        # not an object at all
+        "garbage",
+    ):
+        with pytest.raises(tc.FrameError):
+            tc.decode_frame(_frame_with_entry(entry))
+
+
+def test_duplicate_tensor_name_refused():
+    entry = {"name": "x", "dtype": "float32", "shape": [2],
+             "offset": 0, "nbytes": 8}
+    header = json.dumps({"kind": "t", "model_version": 0,
+                         "tensors": [entry, entry]}).encode()
+    blob = struct.pack("<4sIQ", tc.FRAME_MAGIC, len(header),
+                       64) + header + b"\x00" * 64
+    with pytest.raises(tc.FrameError, match="duplicate"):
+        tc.decode_frame(blob)
+
+
+# -- header-only stream reads (the router's keyed-placement path) ---------
+
+def test_read_frame_header_consumes_exactly_the_header():
+    blob = _good_blob()
+    fp = io.BytesIO(blob)
+    header, prefix, payload_len = tc.read_frame_header(
+        fp, limit=len(blob))
+    assert header["routing_key"] == "k"
+    assert prefix == blob[:len(prefix)]
+    assert len(prefix) + payload_len == len(blob)
+    # The payload was NOT consumed: splicing prefix + rest reproduces
+    # the original bytes exactly (the router's zero-re-encode
+    # invariant).
+    assert prefix + fp.read() == blob
+
+
+def test_read_frame_header_limit_mismatch_refused():
+    blob = _good_blob()
+    with pytest.raises(tc.FrameError, match="transport framed"):
+        tc.read_frame_header(io.BytesIO(blob), limit=len(blob) + 5)
+
+
+def test_read_frame_header_truncated_stream_refused():
+    blob = _good_blob()
+    with pytest.raises(tc.FrameError, match="truncated"):
+        tc.read_frame_header(io.BytesIO(blob[:10]))
+
+
+# -- pytree flatten/unflatten ---------------------------------------------
+
+def test_tree_spec_roundtrip():
+    tree = {"logits": np.arange(4, dtype=np.float32),
+            "aux": [np.arange(3, dtype=np.int64),
+                    {"scale": np.float32(2.0)}]}
+    tensors, spec = tc.flatten_tree(tree)
+    rebuilt = tc.unflatten_tree(spec, dict(tensors))
+    assert np.array_equal(rebuilt["logits"], tree["logits"])
+    assert np.array_equal(rebuilt["aux"][0], tree["aux"][0])
+    assert rebuilt["aux"][1]["scale"] == 2.0
+
+
+def test_tree_spec_missing_tensor_refused():
+    with pytest.raises(tc.FrameError, match="missing tensor"):
+        tc.unflatten_tree("t", {})
+
+
+# -- model frames ---------------------------------------------------------
+
+def test_model_frame_roundtrip_with_embeddings():
+    dense = {"w": np.arange(8, dtype=np.float32).reshape(2, 4),
+             "steps": np.int64(7)}
+    emb = {"users": (np.array([3, 11], np.int64),
+                     np.ones((2, 4), np.float32))}
+    blob = tc.encode_model_frame(dense, emb, version=9)
+    d2, e2, version = tc.decode_model_frame(blob)
+    assert version == 9
+    assert np.array_equal(d2["w"], dense["w"])
+    assert np.array_equal(e2["users"][0], emb["users"][0])
+    assert np.array_equal(e2["users"][1], emb["users"][1])
+
+
+def test_model_frame_bf16_wire_halves_dense_payload():
+    dense = {"w": np.random.RandomState(0)
+             .randn(64, 64).astype(np.float32)}
+    full = tc.encode_model_frame(dense, version=1)
+    compressed = tc.encode_model_frame(dense, version=1,
+                                       wire_dtype="bfloat16")
+    assert len(compressed) < 0.6 * len(full)
+    d2, _, _ = tc.decode_model_frame(compressed)
+    assert d2["w"].dtype == np.float32
+
+
+def test_model_frame_refuses_other_kinds_and_torn_tables():
+    with pytest.raises(tc.FrameError, match="not a model frame"):
+        tc.decode_model_frame(
+            tc.encode_frame({"x": np.zeros(1)}, kind="predict"))
+    # ids without values
+    blob = tc.encode_frame({"ei/users": np.arange(2)},
+                           kind=tc.MODEL_FRAME_KIND)
+    with pytest.raises(tc.FrameError, match="mismatch"):
+        tc.decode_model_frame(blob)
+    # unprefixed tensor
+    blob = tc.encode_frame({"rogue": np.zeros(1)},
+                           kind=tc.MODEL_FRAME_KIND)
+    with pytest.raises(tc.FrameError, match="prefix"):
+        tc.decode_model_frame(blob)
+
+
+def test_hostile_dtypes_refused_as_frame_errors():
+    """dtype "object" resolves via np.dtype (itemsize 8) but
+    np.frombuffer raises a PLAIN ValueError for it — the codec must
+    refuse it (and every non-numeric dtype) as FrameError so a hostile
+    frame stays a 400, never an escaped handler exception."""
+    for dtype in ("object", "O", "str", "U8", "S4", "datetime64[s]",
+                  "V8"):
+        entry = {"name": "x", "dtype": dtype, "shape": [1],
+                 "offset": 0,
+                 "nbytes": np.dtype(dtype).itemsize or 8}
+        with pytest.raises(tc.FrameError):
+            tc.decode_frame(_frame_with_entry(entry))
+    # ...while bfloat16 (the registered extra) stays frameable.
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    arr = np.ones(4, ml_dtypes.bfloat16)
+    out = _rt({"x": arr}).tensors["x"]
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert np.array_equal(out.astype(np.float32),
+                          arr.astype(np.float32))

@@ -43,6 +43,13 @@ QUALIFIED_BLOCKING = {
     # EL006 prove it (the EL009 family, docs/elastic_lint.md).
     ("tracing", "dump_now"):
         "tracing.dump_now() (flight-recorder file IO)",
+    # The binary frame reader (utils/tensor_codec, the serving wire
+    # protocol) parks the calling thread on socket/stream reads until
+    # the peer's header bytes arrive — a request handler may block
+    # here, a lock holder must not.  encode/decode over in-memory
+    # bytes are deliberately NOT listed: they are pure CPU.
+    ("tensor_codec", "read_frame_header"):
+        "tensor_codec.read_frame_header() (blocking stream read)",
 }
 
 # -- tier 2: methods that block on any receiver ---------------------------
