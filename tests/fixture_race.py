@@ -1,0 +1,75 @@
+"""Seeded two-root shared-state race fixture for elastic-lint EL011 +
+the runtime tracer's sampled attribute-access records.
+
+``RacyTelemetryHub`` is the canonical lost-update shape: a flusher
+daemon thread (``Thread(target=self._flush_loop)``) and executor
+workers (``self._pool.submit(self._ingest, ...)``) both read-modify-
+write the same attributes with NO lock — ``_total_reports`` via
+``+=`` and ``_totals`` via in-place dict stores.  EL011 must flag both
+attributes statically (two distinct roots, a write, empty guarded-by
+intersection), and ``drive_race_from_two_threads`` exercises both
+sides under the tracer so ``race_confirmations()`` witnesses the
+counter race at runtime (the dict race stays static-only: instance
+``__getattribute__`` instrumentation sees the attribute fetch, not the
+``__setitem__`` behind it).
+
+The lock exists but is never taken — exactly how these bugs look in
+the wild (PR 4's PS servicer, PR 10's Timing snapshots).  This module
+lives in tests/ (outside the lint gate) precisely so the seeded bug
+stays seeded; ``fixture_race_clean.py`` is the counterpart that must
+stay silent on both halves.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class RacyTelemetryHub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._thread = None
+        self._totals = {}
+        self._total_reports = 0
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True)
+        self._thread.start()
+
+    def submit_report(self, key):
+        return self._pool.submit(self._ingest, key)
+
+    def _flush_loop(self):
+        while not self._stop.wait(0.01):
+            self._flush_once()
+
+    def _flush_once(self):
+        # unguarded read-modify-write racing _ingest's: lost updates
+        self._total_reports += 1
+        self._totals["flushed"] = len(self._totals)
+
+    def _ingest(self, key):
+        self._total_reports += 1
+        self._totals[key] = self._totals.get(key, 0) + 1
+
+    def close(self):
+        self._stop.set()
+        self._pool.shutdown(wait=True)
+
+
+def drive_race_from_two_threads(hub):
+    """One flush pass on a dedicated thread, one ingest on a pool
+    worker — two distinct thread idents touching the shared counters
+    with no lock held, which is all the runtime sampler needs to
+    confirm the race (no scheduling luck required).  The warm-up
+    submit makes the pool worker exist FIRST: executors keep workers
+    alive, so the freshly started flusher cannot be handed the pool
+    thread's ident (the OS recycles idents of joined threads, which
+    would make the two roots look like one thread)."""
+    hub.submit_report("warm").result()
+    flusher = threading.Thread(target=hub._flush_once)
+    flusher.start()
+    flusher.join()
+    hub.submit_report("drill").result()

@@ -1,0 +1,68 @@
+"""Clean counterpart to ``fixture_race.py`` — same two thread roots,
+same shared attributes, zero findings.
+
+Every read-modify-write sits under ``self._lock`` (one guard common to
+both roots), and ``_snapshot`` demonstrates the sanctioned lock-free
+idiom EL011 must NOT flag: an immutable tuple published by a single
+reference assignment (atomic under the GIL), read by the other root
+without the lock.  If EL011 or the runtime sampler ever fires on this
+module, the rule has drifted into crying wolf.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class GuardedTelemetryHub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._thread = None
+        self._totals = {}
+        self._total_reports = 0
+        self._snapshot = ()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True)
+        self._thread.start()
+
+    def submit_report(self, key):
+        return self._pool.submit(self._ingest, key)
+
+    def _flush_loop(self):
+        while not self._stop.wait(0.01):
+            self._flush_once()
+
+    def _flush_once(self):
+        with self._lock:
+            self._total_reports += 1
+            self._totals["flushed"] = len(self._totals)
+            snap = tuple(sorted(self._totals.items()))
+        # atomic publication: plain rebind of an immutable value —
+        # readers take the current version without the lock
+        self._snapshot = snap
+
+    def _ingest(self, key):
+        with self._lock:
+            self._total_reports += 1
+            self._totals[key] = self._totals.get(key, 0) + 1
+        return self._snapshot
+
+    def close(self):
+        self._stop.set()
+        self._pool.shutdown(wait=True)
+
+
+def drive_clean_from_two_threads(hub):
+    """Mirror of fixture_race.drive_race_from_two_threads: both roots
+    touch the counters from distinct threads, every time holding the
+    lock — the sampler must confirm nothing.  Warm-up submit first so
+    the pool worker's ident cannot be recycled onto the flusher (see
+    the racy fixture's docstring)."""
+    hub.submit_report("warm").result()
+    flusher = threading.Thread(target=hub._flush_once)
+    flusher.start()
+    flusher.join()
+    hub.submit_report("drill").result()

@@ -195,3 +195,92 @@ def test_concurrent_pulls_race_pushes_on_same_table():
         assert not torn, "observed a torn embedding row"
     finally:
         stop_all(servers)
+
+
+# -- EL011 runtime confirmation (sampled attribute-access records) ------
+
+
+def test_race_fixture_confirmed_by_sampler_and_merged():
+    """The dynamic half of EL011: drive the seeded fixture's two roots
+    from two real threads under the tracer, then merge the sampled
+    attribute-access records into the STATIC report — the flagged
+    counter race must come back ``confirmed``, exactly like observed
+    order edges confirm EL005 cycles."""
+    from tests.fixture_race import (
+        RacyTelemetryHub,
+        drive_race_from_two_threads,
+    )
+    from tools.elastic_lint import build_program
+    from tools.elastic_lint import el011_shared_state as el011
+
+    hub = RacyTelemetryHub()
+    try:
+        with LockDisciplineTracer() as tracer:
+            tracer.register(hub, attrs=["_total_reports", "_totals"])
+            drive_race_from_two_threads(hub)
+    finally:
+        hub.close()
+    assert ("RacyTelemetryHub", "_total_reports") \
+        in tracer.race_confirmations()
+
+    _, prog = build_program(
+        [os.path.join(REPO, "tests", "fixture_race.py")])
+    report = el011.build_report(prog)
+    statically_flagged = {r["key"][-1] for r in report.races}
+    assert statically_flagged == {"_total_reports", "_totals"}
+    report.merge_observed(tracer.attr_access_records())
+    confirmed = {r["key"][-1] for r in report.confirmed_races()}
+    # the counter race is WITNESSED; the dict race stays static-only
+    # (instance instrumentation sees the attribute fetch, not the
+    # __setitem__ behind it — documented in the fixture)
+    assert confirmed == {"_total_reports"}
+
+
+def test_clean_fixture_sampler_confirms_nothing():
+    """Counterpart drill: identical thread shape, RMWs under one lock,
+    plus the atomic-publication rebind of ``_snapshot`` — the sampler
+    must witness NO race (a bare setattr is a GIL-atomic rebind, not a
+    lost update, so publication does not count as one)."""
+    from tests.fixture_race_clean import (
+        GuardedTelemetryHub,
+        drive_clean_from_two_threads,
+    )
+
+    hub = GuardedTelemetryHub()
+    try:
+        with LockDisciplineTracer() as tracer:
+            tracer.register(
+                hub, attrs=["_total_reports", "_totals", "_snapshot"])
+            drive_clean_from_two_threads(hub)
+    finally:
+        hub.close()
+    assert tracer.race_confirmations() == set()
+    # and the guarded counter really was exercised from two threads
+    idents = {e[4] for e in tracer.events}
+    assert len(idents) >= 2
+
+
+def test_tracer_sampling_bounds_event_volume():
+    """``sample_every=N`` keeps roughly 1/N of the access stream — the
+    knob that makes tracing a hot attribute affordable in a drill."""
+    from tests.fixture_race import RacyTelemetryHub
+
+    dense = RacyTelemetryHub()
+    sparse = RacyTelemetryHub()
+    try:
+        with LockDisciplineTracer() as tracer:
+            tracer.register(dense, attrs=["_total_reports"])
+            tracer.register(sparse, attrs=["_total_reports"],
+                            sample_every=10)
+            for _ in range(200):
+                dense._flush_once()
+                sparse._flush_once()
+        dense_n = sum(1 for e in tracer.events
+                      if e[0] == id(dense))
+        sparse_n = sum(1 for e in tracer.events
+                       if e[0] == id(sparse))
+        assert dense_n >= 400          # read + write per increment
+        assert 0 < sparse_n <= dense_n // 5
+    finally:
+        dense.close()
+        sparse.close()

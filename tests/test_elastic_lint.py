@@ -798,3 +798,261 @@ def test_repo_is_lint_clean():
     assert not findings, "\n".join(
         "%s:%d: %s %s" % (f.path, f.line, f.rule, f.message)
         for f in findings)
+
+
+# -- EL011 whole-program shared-state races -----------------------------
+
+
+RACE_FIXTURE = os.path.join(REPO, "tests", "fixture_race.py")
+RACE_CLEAN_FIXTURE = os.path.join(REPO, "tests",
+                                  "fixture_race_clean.py")
+
+
+def test_el011_flags_seeded_race_fixture():
+    findings = [f for f in check_source(
+        _fixture_source(RACE_FIXTURE), "tests/fixture_race.py")
+        if f.rule == "EL011"]
+    assert {f.symbol for f in findings} == {
+        "RacyTelemetryHub._total_reports",
+        "RacyTelemetryHub._totals",
+    }, "seeded two-root race not (fully) detected"
+    # the finding anchors at the write and carries BOTH witness chains
+    counter = next(f for f in findings
+                   if f.symbol.endswith("_total_reports"))
+    assert counter.line == 50
+    assert "_flush_loop" in counter.message
+    assert "_ingest" in counter.message
+    assert " -> " in counter.message
+
+
+def test_el011_quiet_on_guarded_fixture():
+    """Same two roots, same attributes: RMWs under one common lock and
+    an atomic-publication rebind must both stay silent."""
+    findings = check_source(_fixture_source(RACE_CLEAN_FIXTURE),
+                            "tests/fixture_race_clean.py")
+    assert "EL011" not in {f.rule for f in findings}
+
+
+EL011_READ_VS_WRITE = """
+    import threading
+
+    class Gauge:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._value = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._bump)
+            self._t.start()
+
+        def _bump(self):
+            self._value += 1      # rmw with no lock
+
+        def do_GET(self):         # stdlib-handler-shaped second root
+            return self._value    # unguarded read
+"""
+
+
+def test_el011_write_vs_foreign_read_races():
+    findings = [f for f in check_source(
+        textwrap.dedent(EL011_READ_VS_WRITE)) if f.rule == "EL011"]
+    assert findings and findings[0].symbol == "Gauge._value"
+    assert "http" in findings[0].message  # handler root participates
+
+
+def test_el011_common_lock_suppresses():
+    source = textwrap.dedent(EL011_READ_VS_WRITE).replace(
+        "        self._value += 1      # rmw with no lock",
+        "        with self._lock:\n            self._value += 1",
+    ).replace(
+        "        return self._value    # unguarded read",
+        "        with self._lock:\n            return self._value",
+    )
+    assert "EL011" not in {f.rule for f in check_source(source)}
+
+
+def test_el011_queue_handoff_not_shared_state():
+    source = """
+        import queue
+        import threading
+
+        class Mailbox:
+            def __init__(self):
+                self._inbox = queue.Queue()
+                self._t = None
+
+            def start(self):
+                self._t = threading.Thread(target=self._drain)
+                self._t.start()
+
+            def _drain(self):
+                while True:
+                    self._inbox.get()
+
+            def do_GET(self):
+                self._inbox.put("ping")
+    """
+    assert "EL011" not in rules_hit(source)
+
+
+def test_thread_root_inventory_covers_every_tier():
+    """Root discovery is the foundation EL011 stands on: losing a tier
+    entrypoint silently shrinks the race search space.  Pin one-or-more
+    roots per tier (master, PS, worker, serving server, router/fleet)
+    and the aggregation daemon's honest blind spot."""
+    from tools.elastic_lint import build_program
+    from tools.elastic_lint import el011_shared_state as el011
+
+    _, prog = build_program([os.path.join(REPO, "elasticdl_tpu")],
+                            jobs=2)
+    report = el011.build_report(prog)
+    labels = {info["label"] for info in report.roots.values()}
+    expected = {
+        # master: gRPC plane (both servicer classes), daemon loops,
+        # the status page's nested stdlib handler
+        "rpc:elasticdl_tpu.master.servicer.MasterServicer.get_task",
+        "rpc:elasticdl_tpu.master.scheduler.MultiTenantServicer.get_task",
+        "thread:elasticdl_tpu.master.journal.JournalWriter._flush_loop",
+        "thread:elasticdl_tpu.master.task_manager.TaskManager"
+        "._watch_timeouts",
+        "thread:elasticdl_tpu.master.worker_manager.WorkerManager"
+        "._watch_worker",
+        "thread:elasticdl_tpu.master.scheduler.ResizeController._loop",
+        "thread:elasticdl_tpu.master.ps_manager.PSManager._watch",
+        "http:elasticdl_tpu.master.status_server.Handler.do_GET",
+        # PS: the RPC plane plus the master-watch reconnect daemon
+        "rpc:elasticdl_tpu.ps.servicer.PserverServicer.push_gradients",
+        "rpc:elasticdl_tpu.ps.servicer.PserverServicer"
+        ".pull_embedding_vectors",
+        "thread:elasticdl_tpu.ps.server.ParameterServer._watch_master",
+        # worker: shard-index prefetcher and async checkpoint submit
+        "thread:elasticdl_tpu.worker.data_shard_service"
+        ".RecordIndexService._fill_indices",
+        "submit:elasticdl_tpu.utils.checkpoint.CheckpointSaver.save",
+        # serving server: batcher executor, reload scanner/warmer,
+        # nested HTTP handler
+        "thread:elasticdl_tpu.serving.batcher.ModelBatcher._run",
+        "thread:elasticdl_tpu.serving.server.ModelEndpoint"
+        "._scan_and_swap",
+        "thread:elasticdl_tpu.serving.server.ModelEndpoint"
+        "._prepare_worker",
+        "http:elasticdl_tpu.serving.server.Handler.do_GET",
+        "http:elasticdl_tpu.serving.server.Handler.do_POST",
+        # router + fleet: rollout loop, autoscaler, health prober
+        "http:elasticdl_tpu.serving.router.Handler.do_POST",
+        "thread:elasticdl_tpu.serving.router.Router._rollout_loop",
+        "thread:elasticdl_tpu.serving.fleet.FleetAutoscaler._run",
+        "thread:elasticdl_tpu.serving.fleet.HealthProber._run",
+    }
+    missing = expected - labels
+    assert not missing, "thread roots lost: %s" % sorted(missing)
+    # The aggregation daemon publishes from its MAIN loop; its only
+    # spawn is a nested SIGTERM closure the resolver cannot follow.
+    # It must surface in the opaque list, not vanish.
+    assert any(kind == "signal"
+               and path.endswith("aggregation/main.py")
+               for kind, path, _line in report.opaque_spawns)
+
+
+def test_el011_baseline_suppresses_and_elstale_guards(tmp_path):
+    """The PS hot-path entries use class-granular Class.attr symbols;
+    a live match suppresses, a dead one is a hard ELSTALE error —
+    same zombie-entry hygiene the method-granular rules get."""
+    live = tmp_path / "live.txt"
+    live.write_text(
+        "EL011 tests/fixture_race.py RacyTelemetryHub._total_reports"
+        " -- seeded\n"
+        "EL011 tests/fixture_race.py RacyTelemetryHub._totals"
+        " -- seeded\n")
+    assert run_paths([RACE_FIXTURE], baseline_path=str(live)) == []
+
+    dead = tmp_path / "dead.txt"
+    dead.write_text(
+        "EL011 tests/fixture_race.py RacyTelemetryHub._gone"
+        " -- obsolete\n")
+    findings = run_paths([RACE_FIXTURE], baseline_path=str(dead))
+    stale = [f for f in findings if f.rule == "ELSTALE"]
+    assert stale and "RacyTelemetryHub._gone" in stale[0].symbol
+
+
+def test_races_artifact_names_roots_and_ps_hot_path():
+    """CI artifact contract for --races-out: the matrix names every
+    discovered root, the two baselined PS hot-path races (and only
+    those), and keeps guarded attrs visible as non-racy rows."""
+    import json
+
+    artifact = os.path.join(REPO, "artifacts", "races.json")
+    run_paths([os.path.join(REPO, "elasticdl_tpu")],
+              baseline_path=DEFAULT_BASELINE,
+              races_out=artifact)
+    assert os.path.isfile(artifact)
+    with open(artifact, encoding="utf-8") as f:
+        data = json.load(f)
+    labels = {r["label"] for r in data["roots"]}
+    assert ("rpc:elasticdl_tpu.ps.servicer.PserverServicer"
+            ".pull_embedding_vectors") in labels
+    assert {r["attr"] for r in data["races"]} == {
+        "elasticdl_tpu.ps.servicer.PserverServicer.counters",
+        "elasticdl_tpu.ps.servicer.PserverServicer._params",
+    }
+    # guarded shared state stays in the matrix, marked clean
+    doing = data["attrs"][
+        "elasticdl_tpu.master.task_manager.TaskManager._doing"]
+    assert not doing["racy"]
+    assert any(per_root["guards"]
+               for per_root in doing["roots"].values())
+    # opaque spawn sites are listed, not silently dropped
+    assert any(s["kind"] == "signal" for s in data["opaque_spawns"])
+
+
+# -- --changed scoping ---------------------------------------------------
+
+
+def test_import_closure_pulls_reverse_importers(tmp_path):
+    from tools.elastic_lint import import_closure
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text("X = 1\n")
+    (pkg / "mid.py").write_text("from pkg import base\n")
+    (pkg / "top.py").write_text("from . import mid\n")
+    (pkg / "loner.py").write_text("Y = 2\n")
+    files = ["pkg/__init__.py", "pkg/base.py", "pkg/mid.py",
+             "pkg/top.py", "pkg/loner.py"]
+    scoped = import_closure({"pkg/base.py"}, files, str(tmp_path))
+    assert scoped == {"pkg/base.py", "pkg/mid.py", "pkg/top.py"}
+    # a change outside the lint target set scopes to nothing
+    assert import_closure({"docs/conf.py"}, files, str(tmp_path)) == set()
+
+
+def test_changed_scope_walks_git_and_closure(tmp_path):
+    import subprocess
+
+    from tools.elastic_lint import changed_scope
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t"] + list(args),
+                       cwd=str(tmp_path), check=True,
+                       capture_output=True)
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text("X = 1\n")
+    (pkg / "mid.py").write_text("from pkg import base\n")
+    (pkg / "loner.py").write_text("Y = 2\n")
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    scoped, changed = changed_scope([str(pkg)],
+                                    repo_root=str(tmp_path))
+    assert scoped == [] and changed == set()
+    (pkg / "base.py").write_text("X = 2\n")
+    scoped, changed = changed_scope([str(pkg)],
+                                    repo_root=str(tmp_path))
+    assert changed == {"pkg/base.py"}
+    assert [os.path.basename(p) for p in scoped] == [
+        "base.py", "mid.py"]

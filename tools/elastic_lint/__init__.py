@@ -47,6 +47,12 @@ Whole-program rules (``PROGRAM_RULES``, run over the stitched
                           ``blocking.py``)
   EL008 rpc-conformance   client stub calls vs the hand-registered
                           service tables vs elastic.proto fields
+  EL011 shared-state      attributes reachable from >=2 thread roots
+                          (servicer RPCs, Thread/Timer/submit targets,
+                          HTTP do_* handlers, signal handlers) with a
+                          write whose guarded-by sets share no lock;
+                          emit the root x attribute matrix with
+                          ``--races-out file.{json,dot}``
 
 Suppressions (both forms REQUIRE a justification after ``--``):
 
@@ -87,6 +93,7 @@ from tools.elastic_lint import (  # noqa: E402
     el005_lock_order,
     el006_blocking_under_lock,
     el008_rpc_conformance,
+    el011_shared_state,
     lock_graph,
     program as program_model,
 )
@@ -105,6 +112,7 @@ PROGRAM_RULES = (
     el005_lock_order,
     el006_blocking_under_lock,
     el008_rpc_conformance,
+    el011_shared_state,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(
@@ -191,12 +199,13 @@ def build_program(paths, jobs=1):
 
 
 def run_paths(paths, baseline_path=DEFAULT_BASELINE, jobs=1,
-              graph_out=None):
+              graph_out=None, races_out=None):
     """Lint every .py under ``paths`` (per-file + whole-program rules);
     returns findings that survive both inline pragmas and the baseline
     file, plus ``ELSTALE`` findings for baseline entries that no longer
     match anything.  ``graph_out`` writes the EL005 lock-order graph
-    artifact (DOT, or JSON when the path ends in .json)."""
+    artifact and ``races_out`` the EL011 root×attribute matrix (DOT,
+    or JSON when the path ends in .json)."""
     baseline = suppressions.load_baseline(baseline_path)
     raw, prog = build_program(paths, jobs=jobs)
     program_findings = []
@@ -205,13 +214,19 @@ def run_paths(paths, baseline_path=DEFAULT_BASELINE, jobs=1,
     raw.extend(suppressions.apply_inline_map(
         program_findings, prog.pragmas_by_path))
 
-    if graph_out is not None:
-        graph = lock_graph.build_graph(prog)
-        baselined = {sym for (r, _, sym) in baseline if r == "EL005"}
-        out_dir = os.path.dirname(os.path.abspath(graph_out))
+    for out, build in ((graph_out, None), (races_out, "races")):
+        if out is None:
+            continue
+        out_dir = os.path.dirname(os.path.abspath(out))
         if out_dir and not os.path.isdir(out_dir):
             os.makedirs(out_dir, exist_ok=True)
-        graph.write(graph_out, baselined_signatures=baselined)
+        if build is None:
+            graph = lock_graph.build_graph(prog)
+            baselined = {sym for (r, _, sym) in baseline
+                         if r == "EL005"}
+            graph.write(out, baselined_signatures=baselined)
+        else:
+            el011_shared_state.build_report(prog).write(out)
 
     surviving = suppressions.apply_baseline(raw, baseline)
     surviving.extend(
@@ -221,3 +236,78 @@ def run_paths(paths, baseline_path=DEFAULT_BASELINE, jobs=1,
             repo_root=REPO_ROOT,
         ))
     return surviving
+
+
+def changed_scope(paths, repo_root=None):
+    """File list for ``--changed``: git-modified/untracked files plus
+    their reverse-dependency closure over the import graph of the files
+    ``paths`` would lint.  Returns (scoped files, changed set) — the
+    scoped list is empty when nothing relevant changed."""
+    import subprocess
+    root = repo_root or REPO_ROOT
+    changed = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        res = subprocess.run(cmd, cwd=root, capture_output=True,
+                             text=True, check=True)
+        changed.update(l.strip() for l in res.stdout.splitlines()
+                       if l.strip())
+    all_files = [os.path.relpath(os.path.abspath(p), root)
+                 .replace(os.sep, "/")
+                 for p in iter_python_files(paths)]
+    scoped = import_closure(
+        {c for c in changed if c.endswith(".py")}, all_files, root)
+    # absolute paths, so the scoped run works from any cwd
+    return sorted(os.path.join(root, p) for p in scoped), changed
+
+
+def import_closure(changed, files, root):
+    """Transitive reverse-dependency closure: every file in ``files``
+    whose import graph reaches a changed file (plus the changed files
+    themselves, when linted at all).  A light AST pass — imports only,
+    no rule work — so pre-commit runs stay fast."""
+    by_module = {}
+    for rel in files:
+        mod = rel[:-3].replace("/", ".")
+        by_module[mod] = rel
+        if rel.endswith("/__init__.py"):
+            by_module[rel[: -len("/__init__.py")].replace("/", ".")] = rel
+
+    def targets_of(rel):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return set()
+        modname = rel[:-3].replace("/", ".")
+        out = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    out.add(alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                prefix = node.module or ""
+                if node.level:
+                    parts = modname.split(".")[: -node.level]
+                    prefix = ".".join(parts + ([node.module]
+                                               if node.module else []))
+                if prefix:
+                    out.add(prefix)
+                for alias in node.names:
+                    if alias.name != "*" and prefix:
+                        out.add(prefix + "." + alias.name)
+        return {by_module[t] for t in out if t in by_module}
+
+    importers = {}  # rel -> files importing it
+    for rel in files:
+        for dep in targets_of(rel):
+            importers.setdefault(dep, set()).add(rel)
+    scope = {c for c in changed if c in set(files)}
+    work = list(scope)
+    while work:
+        rel = work.pop()
+        for dep in importers.get(rel, ()):
+            if dep not in scope:
+                scope.add(dep)
+                work.append(dep)
+    return scope

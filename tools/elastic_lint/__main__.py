@@ -3,21 +3,25 @@
 Exits 1 when findings survive inline pragmas and the baseline file,
 0 on a clean run.  ``--no-baseline`` reports everything (audit mode);
 ``--jobs N`` analyzes files in N worker processes (0 = one per CPU);
-``--graph-out FILE`` writes the EL005 lock-order graph artifact (DOT,
-or JSON when FILE ends in .json).
+``--graph-out FILE`` writes the EL005 lock-order graph artifact and
+``--races-out FILE`` the EL011 root×attribute matrix (DOT, or JSON
+when FILE ends in .json).  ``--changed`` scopes the run to git-dirty
+files plus their reverse-dependency closure — the fast pre-commit
+mode; the full-repo run stays the tier-1 gate.
 """
 
 import argparse
 import os
 import sys
 
-from tools.elastic_lint import DEFAULT_BASELINE, REPO_ROOT, run_paths
+from tools.elastic_lint import (DEFAULT_BASELINE, REPO_ROOT,
+                                changed_scope, run_paths)
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
         "elastic-lint",
-        description="project-native static analysis (EL001-EL008)")
+        description="project-native static analysis (EL001-EL011)")
     parser.add_argument("paths", nargs="*",
                         default=["elasticdl_tpu"],
                         help="files or directories to lint")
@@ -29,12 +33,29 @@ def main(argv=None):
     parser.add_argument("--graph-out", default=None, metavar="FILE",
                         help="write the EL005 lock-order graph "
                              "(.dot or .json)")
+    parser.add_argument("--races-out", default=None, metavar="FILE",
+                        help="write the EL011 root×attribute matrix "
+                             "(.dot or .json)")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only git-changed files plus their "
+                             "reverse-dependency closure")
     args = parser.parse_args(argv)
+
+    paths = args.paths
+    if args.changed:
+        paths, changed = changed_scope(paths)
+        if not paths:
+            print("elastic-lint: no lintable files in the change set "
+                  "(%d changed)" % len(changed))
+            return 0
+        print("elastic-lint: --changed scoped to %d file(s)"
+              % len(paths))
 
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     baseline = None if args.no_baseline else args.baseline
-    findings = run_paths(args.paths, baseline_path=baseline,
-                         jobs=jobs, graph_out=args.graph_out)
+    findings = run_paths(paths, baseline_path=baseline,
+                         jobs=jobs, graph_out=args.graph_out,
+                         races_out=args.races_out)
     for f in sorted(findings, key=lambda f: (f.path, f.line)):
         print("%s:%d: %s [%s] %s"
               % (f.path, f.line, f.rule, f.symbol, f.message))

@@ -37,6 +37,12 @@ QUALIFIED_BLOCKING = {
     ("subprocess", "check_output"): "subprocess.check_output()",
     ("grpc_utils", "wait_for_channel_ready"):
         "grpc_utils.wait_for_channel_ready()",
+    # Raw TCP dials park until the peer answers or the timeout fires —
+    # the serving health prober and the router's backend probes dial
+    # sockets on every tick, and a probe-under-lock stalls the whole
+    # control plane behind one dead backend.
+    ("socket", "create_connection"):
+        "socket.create_connection() (TCP dial)",
     # Flight-recorder DUMPS are file IO (utils/tracing.py); the whole
     # point of the recorder's design is that record() is safe under
     # any lock while dump paths never are — this entry is what lets
@@ -102,6 +108,21 @@ _JOURNAL_METHODS = ("append", "flush", "kick", "close")
 _RECORDER_TYPES = {"FlightRecorder", "Tracer"}
 _RECORDER_NAME_HINTS = ("recorder", "tracer")
 _RECORDER_BLOCKING_METHODS = ("dump",)
+# Socket IO: connect/recv/accept park on the kernel until the peer
+# acts; sendall can park on a full send buffer.  Gated on the
+# receiver's kind so an unrelated `.connect()` (e.g. a signal/slot
+# API) cannot fire.  The daemon loops added in PRs 9-14 probe sockets
+# and shell out — holding a lock across these was invisible before.
+SOCKET_TYPES = {"socket"}
+_SOCKET_NAME_HINTS = ("sock",)
+_SOCKET_BLOCKING_METHODS = ("connect", "recv", "recv_into", "accept",
+                            "sendall")
+# http.client round-trips (the router/fleet data plane's transport):
+# request() writes to the socket, getresponse() blocks until the
+# backend's status line arrives.
+HTTP_CONN_TYPES = {"HTTPConnection", "HTTPSConnection"}
+_HTTP_CONN_NAME_HINTS = ("conn",)
+_HTTP_CONN_METHODS = ("request", "getresponse")
 
 
 def _receiver_name(node):
@@ -174,6 +195,16 @@ def classify_call(call, type_of=None):
         if ctor in _RECORDER_TYPES or (
                 ctor is None and _hinted(name, _RECORDER_NAME_HINTS)):
             return "flight-recorder %s() (file IO)" % method
+
+    # tier 3 — socket IO and http.client round-trips
+    if method in _SOCKET_BLOCKING_METHODS:
+        if ctor in SOCKET_TYPES or (
+                ctor is None and _hinted(name, _SOCKET_NAME_HINTS)):
+            return "socket.%s() (network IO)" % method
+    if method in _HTTP_CONN_METHODS:
+        if ctor in HTTP_CONN_TYPES or (
+                ctor is None and _hinted(name, _HTTP_CONN_NAME_HINTS)):
+            return "http %s() (network round-trip)" % method
 
     # tier 3 — receiver-kind gated
     if method == "result":

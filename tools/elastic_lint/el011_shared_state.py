@@ -1,0 +1,333 @@
+"""EL011 — whole-program shared-state race detection (guarded-by
+inference over thread roots).
+
+Every manual review pass in this repo's history found a cross-thread
+state bug by hand: the PS servicer holding its update lock across a
+master RPC (PR 4), Timing snapshot races (PR 10), the SIGQUIT recorder
+deadlock (PR 13).  This rule mechanizes the hunt.  The model:
+
+  1. **Thread roots** (``Program.thread_roots``): gRPC servicer RPC
+     methods, stdlib HTTP handler ``do_*`` methods, ``Thread(target=)``
+     / ``Timer`` callables, ``executor.submit`` arguments, and signal
+     handlers.  Each root is an entrypoint that may run concurrently
+     with every other root (and with another instance of itself — but
+     the static rule only fires across DISTINCT roots, see below).
+  2. **Guarded-by sets**: for each root, ``Program.root_reachability``
+     computes per-function must-held lock sets — the intersection over
+     all call paths from the root — and every ``self._attr`` access
+     site adds its locally-held locks on top.
+  3. **The race predicate**: an attribute touched from ≥2 distinct
+     roots, with at least one write, where some write's guard set and
+     some other root's access guard set have an EMPTY intersection —
+     no single lock orders the two accesses.  The finding anchors at
+     the write and carries both root→…→access witness chains.
+
+Recognized lock-free idioms (suppressed structurally, not by name):
+
+  - **atomic publication**: every write to the attribute, anywhere in
+    the program, is a plain rebind whose RHS never reads the same
+    attribute (``self._active = (model, dtypes, plan)``) — a single
+    reference assignment is atomic under the GIL and readers tolerate
+    one-version staleness.  A read-modify-write (``self._n += 1``,
+    ``self._x = self._x + 1``) or any in-place container mutation
+    disqualifies the attribute: those are exactly the lost-update
+    shapes the rule exists for.
+  - **self-synchronizing handoffs**: attributes whose inferred
+    constructor is a ``Queue``/``Event``/``Condition``/``Semaphore``/
+    ``Barrier``/``deque`` — the object IS the synchronization.
+  - **the ``_locked`` suffix convention**: such methods assume the
+    class's primary lock (EL001's contract), so their accesses carry
+    it in the guard set already.
+
+Everything else is a finding or a justified ``baseline.txt`` entry
+(symbol ``Class.attr``); ELSTALE covers EL011 entries like any other
+rule.  The runtime tracer's sampled attribute records are merged in as
+``confirmed`` races — same contract as EL005's confirmed cycles.
+
+Known blind spots (documented, deliberate): the main thread is not a
+root, so main-vs-daemon races are left to the runtime sampler; calls
+through closures (nested HTTP handlers calling captured functions) do
+not resolve, bounding handler reachability to what the handler class
+itself does.
+"""
+
+import json
+from collections import namedtuple
+
+from tools.elastic_lint import Finding
+from tools.elastic_lint.program import lock_display
+
+RULE_ID = "EL011"
+
+# Attribute types that synchronize themselves: the object is the
+# handoff protocol, not shared state needing an external lock.
+SELF_SYNC_CTORS = {
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "JoinableQueue", "Event", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "deque",
+}
+
+ROOT_KIND_LABEL = {
+    "rpc": "gRPC servicer thread",
+    "http": "HTTP handler thread",
+    "thread": "daemon thread",
+    "timer": "timer callback",
+    "submit": "executor worker",
+    "signal": "signal handler",
+}
+
+_Site = namedtuple("_Site", "mode wkind path line guards fid")
+
+
+def _attr_display(key):
+    mod, cls, attr = key
+    return "%s.%s.%s" % (mod, cls, attr)
+
+
+def _root_label(prog, fid, kinds):
+    return "%s:%s.%s" % ("/".join(sorted(kinds)), fid[0],
+                         prog.qualname(fid))
+
+
+def _guards_display(guards):
+    return "/".join(sorted(guards)) if guards else "no lock"
+
+
+class RaceReport:
+    """The root×attribute matrix, the derived races, and the artifact
+    writers.  Built once per Program (memoized) — findings and the
+    ``--races-out`` artifact share one analysis."""
+
+    def __init__(self):
+        self.roots = {}          # root fid -> {"kinds", "label", ...}
+        self.opaque_spawns = []  # [(kind, path, line)]
+        self.matrix = {}         # attr key -> {root fid: [_Site]}
+        self.attr_classes = {}   # attr key -> class names seen touching it
+        self.races = []          # race dicts (see _add_race)
+        self.findings = []
+        self.confirmed = set()   # attr keys confirmed by the tracer
+
+    # -- runtime confirmation (same contract as LockGraph's
+    # merge_observed/confirmed_cycles) ----------------------------------
+
+    def merge_observed(self, records):
+        """Merge runtime attribute-access records, confirming
+        statically detected races.  ``records`` iterates (class_name,
+        attr, mode, thread_ident, held_lock_labels) from the runtime
+        tracer (``attr_access_records``); the shared
+        ``confirmed_attr_keys`` predicate decides which (class, attr)
+        pairs were witnessed racing, so the static and runtime halves
+        cannot drift."""
+        from tools.elastic_lint.runtime_tracer import confirmed_attr_keys
+
+        hot = confirmed_attr_keys(records)
+        for key in self.matrix:
+            classes = self.attr_classes.get(key, set()) | {key[1]}
+            for cls in classes:
+                if (cls, key[2]) in hot:
+                    self.confirmed.add(key)
+        return self.confirmed
+
+    def confirmed_races(self):
+        return [r for r in self.races if r["key"] in self.confirmed]
+
+    # -- artifacts -------------------------------------------------------
+
+    def to_json(self):
+        attrs = {}
+        for key in sorted(self.matrix):
+            per_root = {}
+            for root_fid, sites in sorted(self.matrix[key].items()):
+                label = self.roots[root_fid]["label"]
+                guard_sets = [s.guards for s in sites]
+                always = frozenset.intersection(*guard_sets)
+                per_root[label] = {
+                    "reads": sum(1 for s in sites if s.mode == "read"),
+                    "writes": sum(1 for s in sites if s.mode == "write"),
+                    "guards": sorted(always),
+                }
+            racy = any(r["key"] == key for r in self.races)
+            attrs[_attr_display(key)] = {
+                "racy": racy,
+                "confirmed": key in self.confirmed,
+                "roots": per_root,
+            }
+        return json.dumps({
+            "roots": [
+                {"label": info["label"],
+                 "kinds": sorted(info["kinds"]),
+                 "path": info["path"], "line": info["line"]}
+                for _, info in sorted(self.roots.items())
+            ],
+            "opaque_spawns": [
+                {"kind": k, "path": p, "line": ln}
+                for k, p, ln in sorted(self.opaque_spawns)
+            ],
+            "attrs": attrs,
+            "races": [
+                {"attr": _attr_display(r["key"]),
+                 "confirmed": r["key"] in self.confirmed,
+                 "write": r["write"], "access": r["access"]}
+                for r in self.races
+            ],
+        }, indent=2, sort_keys=True)
+
+    def to_dot(self):
+        lines = ["digraph races {", "  rankdir=LR;",
+                 '  node [fontsize=10];']
+        racy_keys = {r["key"] for r in self.races}
+        for _, info in sorted(self.roots.items()):
+            lines.append('  "%s" [shape=box];' % info["label"])
+        for key in sorted(self.matrix):
+            attr_node = _attr_display(key)
+            shape = ('ellipse, color=red, penwidth=2'
+                     if key in racy_keys else 'ellipse')
+            lines.append('  "%s" [shape=%s];' % (attr_node, shape))
+            for root_fid, sites in sorted(self.matrix[key].items()):
+                label = self.roots[root_fid]["label"]
+                mode = ("w" if any(s.mode == "write" for s in sites)
+                        else "r")
+                color = (", color=red" if key in racy_keys
+                         and any(not s.guards for s in sites) else "")
+                lines.append('  "%s" -> "%s" [label="%s"%s];'
+                             % (label, attr_node, mode, color))
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+    def write(self, path):
+        payload = self.to_dot() if path.endswith(".dot") else (
+            self.to_json() + "\n")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(payload)
+
+
+def build_report(prog):
+    if prog._race_report_cache is not None:
+        return prog._race_report_cache
+    report = RaceReport()
+    roots, opaque = prog.thread_roots()
+    report.opaque_spawns = list(opaque)
+    for fid, kinds in roots.items():
+        modsum, _, fsum = prog.functions[fid]
+        report.roots[fid] = {
+            "kinds": set(kinds),
+            "label": _root_label(prog, fid, kinds),
+            "path": modsum.path,
+            "line": fsum.line,
+        }
+
+    # program-wide write kinds per canonical attribute — the atomic-
+    # publication test must see EVERY write, root-reachable or not
+    global_wkinds = {}
+    for fid, (modsum, clssum, fsum) in prog.functions.items():
+        if fid[1] is None:
+            continue
+        for attr, mode, wkind, _line, _held in fsum.accesses:
+            if mode != "write":
+                continue
+            owner = prog.resolve_attr_owner(fid[0], fid[1], attr)
+            global_wkinds.setdefault(owner + (attr,), set()).add(wkind)
+
+    chains = {}  # root fid -> parents map, for witness chains
+    for root_fid in sorted(report.roots,
+                           key=lambda f: (f[0], f[1] or "", f[2])):
+        must_held, parents = prog.root_reachability(root_fid)
+        chains[root_fid] = parents
+        for fid, entry_locks in must_held.items():
+            if fid[1] is None:
+                continue
+            modsum, _, fsum = prog.functions[fid]
+            for attr, mode, wkind, line, held in fsum.accesses:
+                owner = prog.resolve_attr_owner(fid[0], fid[1], attr)
+                owner_sum = prog._find_class(*owner)
+                if owner_sum is None:
+                    continue
+                # not a data attribute this class ever assigns
+                # (method references, stdlib base attrs) — skip
+                if attr not in owner_sum.assigned_attrs:
+                    continue
+                # the lock IS the synchronization, not shared data
+                if attr in owner_sum.lock_attrs:
+                    continue
+                t = owner_sum.attr_types.get(attr)
+                if (t is not None and t[0] in ("ctor", "ctorlist")
+                        and t[1] in SELF_SYNC_CTORS):
+                    continue
+                key = owner + (attr,)
+                guards = frozenset(entry_locks) | {
+                    lock_display(prog.resolve_lock(fid, h))
+                    for h in held}
+                site = _Site(mode, wkind, modsum.path, line,
+                             frozenset(guards), fid)
+                report.matrix.setdefault(key, {}).setdefault(
+                    root_fid, []).append(site)
+                report.attr_classes.setdefault(key, set()).add(fid[1])
+
+    for key in sorted(report.matrix):
+        per_root = report.matrix[key]
+        if len(per_root) < 2:
+            continue
+        # atomic publication: every write anywhere is a pure rebind
+        if global_wkinds.get(key, {"rebind"}) == {"rebind"}:
+            continue
+        race = _first_race(per_root)
+        if race is None:
+            continue
+        (w_root, w_site), (a_root, a_site) = race
+        _add_race(prog, report, chains, key,
+                  w_root, w_site, a_root, a_site)
+    prog._race_report_cache = report
+    return report
+
+
+def _first_race(per_root):
+    """The deterministic first (write, other-root access) pair with an
+    empty guard intersection, or None."""
+    writes = sorted(
+        ((root, s) for root, sites in per_root.items()
+         for s in sites if s.mode == "write"),
+        key=lambda rs: (rs[1].path, rs[1].line, rs[0]))
+    for w_root, w_site in writes:
+        for a_root in sorted(per_root):
+            if a_root == w_root:
+                continue
+            for a_site in sorted(per_root[a_root],
+                                 key=lambda s: (s.path, s.line)):
+                if not (w_site.guards & a_site.guards):
+                    return (w_root, w_site), (a_root, a_site)
+    return None
+
+
+def _add_race(prog, report, chains, key, w_root, w_site, a_root,
+              a_site):
+    w_label = report.roots[w_root]["label"]
+    a_label = report.roots[a_root]["label"]
+    w_chain = "%s:%d" % (
+        prog.root_chain(chains[w_root], w_site.fid), w_site.line)
+    a_chain = "%s:%d" % (
+        prog.root_chain(chains[a_root], a_site.fid), a_site.line)
+    symbol = "%s.%s" % (key[1], key[2])
+    report.races.append({
+        "key": key,
+        "write": {"root": w_label, "path": w_site.path,
+                  "line": w_site.line,
+                  "guards": sorted(w_site.guards), "chain": w_chain},
+        "access": {"root": a_label, "mode": a_site.mode,
+                   "path": a_site.path, "line": a_site.line,
+                   "guards": sorted(a_site.guards), "chain": a_chain},
+    })
+    report.findings.append(Finding(
+        RULE_ID, w_site.path, w_site.line, symbol,
+        "shared attribute %s is written from %s holding %s and "
+        "accessed from %s holding %s — no common lock orders the two "
+        "(write: %s; access: %s). Guard both sites with one lock, "
+        "publish an immutable snapshot by single assignment, or hand "
+        "off through a queue; intentional lock-freedom belongs in the "
+        "baseline with a reason"
+        % (_attr_display(key), w_label, _guards_display(w_site.guards),
+           a_label, _guards_display(a_site.guards), w_chain, a_chain),
+    ))
+
+
+def check_program(prog):
+    return list(build_report(prog).findings)

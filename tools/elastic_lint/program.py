@@ -44,6 +44,27 @@ from tools.elastic_lint import blocking
 from tools.elastic_lint.suppressions import _PRAGMA, _pragma_rules
 
 LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+# Receiver method calls that mutate the receiver in place — a
+# ``self._attr.append(...)`` is a WRITE to the shared structure even
+# though the attribute binding itself never changes (EL011).  Only
+# fires when the attribute IS a plain container (or its type is
+# unknown, i.e. a literal): an object with its own API — say
+# ``self._journal.append(...)`` on the internally-locked
+# JournalWriter — is a call through a reference, modeled as a call
+# edge and judged inside ITS class, not a mutation of the attribute.
+MUTATOR_METHODS = {
+    "append", "appendleft", "add", "update", "pop", "popleft",
+    "popitem", "remove", "discard", "clear", "extend", "insert",
+    "setdefault", "sort",
+}
+CONTAINER_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter",
+}
+# Executor receivers whose ``.submit(fn)`` argument becomes a thread
+# root; gated by ctor/name so ``registry.submit`` does not fire.
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_EXECUTOR_NAME_HINTS = ("pool", "executor", "exec")
 PB_MESSAGE_API = {
     "SerializeToString", "FromString", "ByteSize", "CopyFrom", "Clear",
     "ClearField", "HasField", "WhichOneof", "IsInitialized", "MergeFrom",
@@ -75,7 +96,7 @@ def _dotted_ctor(func):
 
 class FuncSummary:
     __slots__ = ("name", "qualname", "line", "assume_locked", "acquires",
-                 "edges", "calls", "blocking")
+                 "edges", "calls", "blocking", "accesses", "spawns")
 
     def __init__(self, name, qualname, line, assume_locked):
         self.name = name
@@ -86,11 +107,15 @@ class FuncSummary:
         self.edges = []      # [(outer lockref, inner lockref, line)]
         self.calls = []      # [(callref, line, held lockref tuple)]
         self.blocking = []   # [(desc, line, held lockref tuple)]
+        # EL011 raw material: self-attribute touches and thread spawns.
+        self.accesses = []   # [(attr, "read"|"write", wkind|None, line,
+        #                       held lockref tuple)]
+        self.spawns = []     # [(kind, callref|None, line)]
 
 
 class ClassSummary:
     __slots__ = ("name", "line", "bases", "methods", "lock_attrs",
-                 "attr_types", "init_params")
+                 "attr_types", "init_params", "assigned_attrs")
 
     def __init__(self, name, line):
         self.name = name
@@ -100,13 +125,14 @@ class ClassSummary:
         self.lock_attrs = {}  # attr -> "Lock" | "RLock" | "Condition" | None
         self.attr_types = {}  # attr -> ("ctor"|"ctorlist"|"param", name)
         self.init_params = ()
+        self.assigned_attrs = set()  # every attr this class assigns
 
 
 class ModuleSummary:
     __slots__ = ("path", "modname", "imports", "classes", "functions",
                  "global_locks", "pragmas", "msg_ctors", "msg_fields",
                  "pb_refs", "rpc_calls", "services", "stub_factories",
-                 "servicers", "thread_sites")
+                 "servicers", "thread_sites", "http_handlers")
 
     def __init__(self, path, modname):
         self.path = path
@@ -126,6 +152,7 @@ class ModuleSummary:
         self.stub_factories = {}  # assigned name -> service
         self.servicers = {}     # class -> [rpc method names]
         self.thread_sites = []  # [(ctor, line)] (EL007 cross-checks)
+        self.http_handlers = []  # class names with do_* methods
 
 
 # ---------------------------------------------------------------------------
@@ -260,6 +287,64 @@ class _FuncScanner(ast.NodeVisitor):
     def visit_Lambda(self, node):
         pass
 
+    # -- shared-state accesses (EL011 raw material) --------------------
+
+    def _self_attr(self, node):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and self._cls is not None):
+            return node.attr
+        return None
+
+    def _record_access(self, attr, mode, wkind, line):
+        # __init__ runs happens-before every spawn; lock attrs are the
+        # synchronization, not the shared data.
+        if self._f.name == "__init__":
+            return
+        if attr in self._cls.lock_attrs:
+            return
+        self._f.accesses.append(
+            (attr, mode, wkind, line, tuple(self._held)))
+
+    def _reads_self_attr(self, expr, attr):
+        for sub in ast.walk(expr):
+            if (isinstance(sub, ast.Attribute) and sub.attr == attr
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                return True
+        return False
+
+    def _record_stores(self, target, rhs):
+        """Classify a store target: plain rebind (candidate for the
+        atomic-publication idiom), read-modify-write rebind, or
+        in-place container mutation."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_stores(elt, rhs)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_stores(target.value, rhs)
+            return
+        attr = self._self_attr(target)
+        if attr is not None:
+            wkind = ("rmw" if rhs is not None
+                     and self._reads_self_attr(rhs, attr) else "rebind")
+            self._record_access(attr, "write", wkind, target.lineno)
+            return
+        node = target
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            node = node.value
+            attr = self._self_attr(node)
+            if attr is not None:
+                self._record_access(
+                    attr, "write", "inplace", target.lineno)
+                return
+
+    def _spawn(self, kind, expr, line):
+        callref = self._callref(expr) if expr is not None else None
+        self._f.spawns.append((kind, callref, line))
+
     # -- assignments: local type inference + pb field writes -----------
 
     def visit_Assign(self, node):
@@ -276,7 +361,30 @@ class _FuncScanner(ast.NodeVisitor):
                 if t is not None:
                     self._local_types[target.id] = t
             else:
+                self._record_stores(target, node.value)
                 self.visit(target)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        # passing the whole AugAssign as rhs makes _reads_self_attr see
+        # the target itself, classifying `self._n += 1` as rmw
+        self._record_stores(node.target, node)
+        self.visit(node.target)
+
+    def visit_Delete(self, node):
+        for target in node.targets:
+            attr = self._self_attr(target)
+            if attr is None:
+                sub = target
+                while isinstance(sub, (ast.Subscript, ast.Attribute)):
+                    sub = sub.value
+                    attr = self._self_attr(sub)
+                    if attr is not None:
+                        break
+            if attr is not None:
+                self._record_access(
+                    attr, "write", "inplace", target.lineno)
+        self.generic_visit(node)
 
     def visit_comprehension_generators(self, generators):
         for gen in generators:
@@ -322,6 +430,11 @@ class _FuncScanner(ast.NodeVisitor):
             elif value.id in self._pb and isinstance(node.ctx, ast.Load):
                 self._mod.pb_refs.append(
                     (node.attr, node.lineno, self._f.qualname))
+        # EL011: reads of self-attributes (stores are recorded with
+        # their write kind by visit_Assign/visit_AugAssign/visit_Delete)
+        attr = self._self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._record_access(attr, "read", None, node.lineno)
         self.generic_visit(node)
 
     # -- calls ----------------------------------------------------------
@@ -413,6 +526,46 @@ class _FuncScanner(ast.NodeVisitor):
                                if kw.arg is not None)
                 self._mod.msg_ctors.append(
                     (leaf, kwargs, node.lineno, self._f.qualname))
+        # thread-root spawn sites (EL011): the spawned callable runs
+        # concurrently with every other root
+        ctor_leaf = dotted.rpartition(".")[2] if dotted else None
+        if ctor_leaf in ("Thread", "Timer"):
+            target_expr = None
+            kwarg = "target" if ctor_leaf == "Thread" else "function"
+            for kw in node.keywords:
+                if kw.arg == kwarg:
+                    target_expr = kw.value
+            if target_expr is None and len(node.args) >= 2:
+                # Thread(group, target, ...) / Timer(interval, function)
+                target_expr = node.args[1]
+            self._spawn(ctor_leaf.lower(), target_expr, node.lineno)
+        elif dotted == "signal.signal" and len(node.args) >= 2:
+            self._spawn("signal", node.args[1], node.lineno)
+        elif (isinstance(func, ast.Attribute) and func.attr == "submit"
+              and node.args):
+            recv = func.value
+            t = self._type_of(recv)
+            recv_name = None
+            if isinstance(recv, ast.Attribute):
+                recv_name = recv.attr
+            elif isinstance(recv, ast.Name):
+                recv_name = recv.id
+            if ((t is not None and t[0] in ("ctor", "ctorlist")
+                 and t[1] in _EXECUTOR_CTORS)
+                    or (recv_name is not None
+                        and any(h in recv_name.lower()
+                                for h in _EXECUTOR_NAME_HINTS))):
+                self._spawn("submit", node.args[0], node.lineno)
+        # in-place mutation of a self-attribute through a mutator
+        # method: `self._pending.append(x)` writes shared state
+        if isinstance(func, ast.Attribute) and func.attr in MUTATOR_METHODS:
+            recv_attr = self._self_attr(func.value)
+            if recv_attr is not None:
+                t = self._cls.attr_types.get(recv_attr)
+                if t is None or (t[0] in ("ctor", "ctorlist")
+                                 and t[1] in CONTAINER_CTORS):
+                    self._record_access(
+                        recv_attr, "write", "inplace", node.lineno)
         # blocking registry
         desc = blocking.classify_call(node, self._type_of)
         if desc is not None:
@@ -458,6 +611,7 @@ def _class_prepass(cls, modsum, pb_aliases):
                         and target.value.id == "self"):
                     continue
                 attr = target.attr
+                summary.assigned_attrs.add(attr)
                 value = node.value
                 ctor = None
                 if isinstance(value, ast.Call):
@@ -568,11 +722,19 @@ def summarize_module(tree, source, path, modname=None):
             scanner.visit(stmt)
         return fsum
 
+    def _is_http_handler(cls):
+        return any(
+            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and re.match(r"do_[A-Z]+$", m.name)
+            for m in cls.body)
+
+    top_level_classes = set()
     for node in tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             modsum.functions[node.name] = scan_function(
                 node, None, node.name)
         elif isinstance(node, ast.ClassDef):
+            top_level_classes.add(id(node))
             clssum = _class_prepass(node, modsum, pb_aliases)
             modsum.classes[node.name] = clssum
             for method in node.body:
@@ -590,6 +752,30 @@ def summarize_module(tree, source, path, modname=None):
                     and len(m.args.args) >= 2
                     and m.args.args[1].arg == "request"
                 ]
+            if _is_http_handler(node):
+                modsum.http_handlers.append(node.name)
+    # stdlib HTTP request handlers are conventionally defined as
+    # classes NESTED inside a factory/__init__ (closing over server
+    # state); their do_* methods run on server threads, so EL011 must
+    # see them even though the top-level walk cannot.  Closure-variable
+    # calls inside them stay unresolved — a documented blind spot.
+    for outer in ast.walk(tree):
+        if (not isinstance(outer, ast.ClassDef)
+                or id(outer) in top_level_classes
+                or not _is_http_handler(outer)):
+            continue
+        name = outer.name
+        if name in modsum.classes:
+            name = "%s@%d" % (outer.name, outer.lineno)
+        clssum = _class_prepass(outer, modsum, pb_aliases)
+        clssum.name = name
+        modsum.classes[name] = clssum
+        for method in outer.body:
+            if isinstance(method, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                clssum.methods[method.name] = scan_function(
+                    method, clssum, "%s.%s" % (name, method.name))
+        modsum.http_handlers.append(name)
     for call in ast.walk(tree):
         if isinstance(call, ast.Call):
             ctor = _dotted_ctor(call.func)
@@ -641,6 +827,11 @@ class Program:
         # memoized by lock_graph.build_graph: the gate builds the
         # graph for EL005 findings AND the --graph-out artifact.
         self._lock_graph_cache = None
+        # memoized by el011_shared_state.build_report (findings AND
+        # the --races-out artifact share one analysis), plus the
+        # discovered thread roots.
+        self._race_report_cache = None
+        self._roots_cache = None
 
     # -- name resolution -----------------------------------------------
 
@@ -845,6 +1036,111 @@ class Program:
                     yield desc, line
             self._may_block = self._fixpoint(direct)
         return self._may_block
+
+    # -- thread roots and per-root guarded-by reachability (EL011) -------
+
+    def thread_roots(self):
+        """Discover every entrypoint that runs on its own thread.
+
+        Returns ``(roots, opaque)``: ``roots`` maps fid -> set of kinds
+        ("rpc" for gRPC servicer methods, "http" for stdlib handler
+        do_* methods, "thread"/"timer"/"submit"/"signal" for spawn
+        sites whose callable resolved), ``opaque`` lists spawn sites
+        whose callable could NOT be resolved (lambdas, closures, bound
+        methods of non-project types) as (kind, path, line) — honest
+        blind spots, not silently dropped."""
+        if self._roots_cache is not None:
+            return self._roots_cache
+        roots = {}
+        opaque = []
+        for modname in sorted(self.modules):
+            modsum = self.modules[modname]
+            for cls in sorted(modsum.servicers):
+                for m in modsum.servicers[cls]:
+                    fid = (modname, cls, m)
+                    if fid in self.functions:
+                        roots.setdefault(fid, set()).add("rpc")
+            for cls in modsum.http_handlers:
+                csum = modsum.classes[cls]
+                for m in sorted(csum.methods):
+                    if re.match(r"do_[A-Z]+$", m):
+                        roots.setdefault(
+                            (modname, cls, m), set()).add("http")
+        for fid in sorted(self.functions,
+                          key=lambda f: (f[0], f[1] or "", f[2])):
+            modsum, _, fsum = self.functions[fid]
+            for kind, callref, line in fsum.spawns:
+                callee = (self.resolve_call(fid, callref)
+                          if callref is not None else None)
+                if callee is not None:
+                    roots.setdefault(callee, set()).add(kind)
+                else:
+                    opaque.append((kind, modsum.path, line))
+        self._roots_cache = (roots, opaque)
+        return self._roots_cache
+
+    def root_reachability(self, root):
+        """``(must_held, parents)`` over the call graph from ``root``.
+
+        ``must_held[fid]`` is the set of lock display names held on
+        EVERY path from the root's entry to ``fid``'s entry (intersection
+        over call paths — monotone decreasing, so the worklist
+        terminates); ``parents[fid]`` is a (caller, callsite line)
+        witness pointer from the first discovery, for human chains."""
+        calls = self._resolve_all_calls()
+        must = {root: frozenset()}
+        parents = {root: None}
+        work = [root]
+        while work:
+            fid = work.pop()
+            base = must[fid]
+            for callee, line, held, _ in calls.get(fid, ()):
+                inc = base | {
+                    lock_display(self.resolve_lock(fid, h))
+                    for h in held}
+                old = must.get(callee)
+                if old is None:
+                    must[callee] = frozenset(inc)
+                    parents[callee] = (fid, line)
+                    work.append(callee)
+                elif not old <= inc:
+                    must[callee] = old & frozenset(inc)
+                    work.append(callee)
+        return must, parents
+
+    def root_chain(self, parents, fid):
+        """Human witness chain root -> ... -> fid (qualnames)."""
+        names = []
+        cur = fid
+        while cur is not None and len(names) < 12:
+            names.append(self.functions[cur][2].qualname)
+            p = parents.get(cur)
+            cur = p[0] if p else None
+        return " -> ".join(reversed(names))
+
+    def resolve_attr_owner(self, modname, cname, attr):
+        """Canonical (module, class) owning a data attribute: the
+        deepest base that assigns it, mirroring resolve_lock's
+        construct-site canonicalization so a subclass access and a
+        base-class access agree on one identity."""
+        owner_mod, owner_cls = modname, cname
+        for _ in range(5):
+            csum = self._find_class(owner_mod, owner_cls)
+            if csum is None:
+                break
+            parent = None
+            for base in csum.bases:
+                hit = (self._resolve_dotted(
+                    self.modules[owner_mod], base) if base else None)
+                if hit is not None and hit[1] is not None:
+                    bsum = self._find_class(*hit)
+                    if bsum is not None and attr in bsum.assigned_attrs:
+                        parent = hit
+                        break
+            if parent is None:
+                break
+            owner_mod, owner_cls = parent
+        return owner_mod, owner_cls
 
     def chain(self, fid, key, facts, limit=6):
         """Human call chain from fid to the fact's origin."""

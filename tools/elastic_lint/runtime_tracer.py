@@ -39,6 +39,41 @@ import threading
 _SELF_SYNC = (threading.Event, threading.Condition, threading.Semaphore)
 
 
+def confirmed_attr_keys(records):
+    """{(class label, attr)} whose records witness a data race.
+
+    ``records`` iterates (class label, attr, mode, thread ident, held
+    lock labels) in observation order.  A key is confirmed when a
+    WRITE that is part of a read-modify-write (the writer thread read
+    the same attribute earlier — a bare ``setattr`` is an atomic
+    reference rebind under the GIL, i.e. the sanctioned publication
+    idiom, so it alone proves nothing) coexists with another thread's
+    access sharing NO held lock.  One function serves both the
+    tracer's ``race_confirmations`` and EL011's ``merge_observed`` so
+    the runtime and static halves cannot drift on what "confirmed"
+    means."""
+    by_attr = {}
+    for idx, (cls, attr, mode, ident, held) in enumerate(records):
+        by_attr.setdefault((cls, attr), []).append(
+            (idx, mode, ident, frozenset(held)))
+    confirmed = set()
+    for key, accesses in by_attr.items():
+        read_idx = {}
+        for idx, mode, ident, _held in accesses:
+            if mode == "read":
+                read_idx.setdefault(ident, idx)
+        for idx, mode, w_ident, w_held in accesses:
+            if mode != "write":
+                continue
+            if read_idx.get(w_ident, idx) >= idx:
+                continue
+            if any(a_ident != w_ident and not (w_held & a_held)
+                   for _i, _m, a_ident, a_held in accesses):
+                confirmed.add(key)
+                break
+    return confirmed
+
+
 class TrackedLock:
     """Wraps a Lock/RLock, recording which threads currently hold it
     and (when owned by a tracer) reporting acquisition-ORDER edges:
@@ -133,13 +168,17 @@ class LockDisciplineTracer:
 
     # -- instrumentation ----------------------------------------------
 
-    def register(self, obj, attrs=None, lock_attr="_lock"):
+    def register(self, obj, attrs=None, lock_attr="_lock",
+                 sample_every=1):
         """Instrument ``obj`` so accesses to ``attrs`` are recorded.
 
         ``attrs=None`` tracks every instance attribute except the lock
         itself and self-synchronized primitives (Event/Condition/
-        Semaphore/queues).  Call before handing the object to worker
-        threads."""
+        Semaphore/queues).  ``sample_every=N`` records every Nth access
+        per object (EL011's sanitizer half wants presence, not a full
+        trace — sampling bounds drill overhead on hot attributes; the
+        counter is racy itself, which only perturbs WHICH accesses are
+        kept).  Call before handing the object to worker threads."""
         lock = getattr(obj, lock_attr)
         label = "%s.%s" % (type(obj).__name__, lock_attr)
         if not isinstance(lock, TrackedLock):
@@ -158,12 +197,22 @@ class LockDisciplineTracer:
         tracer = self
         original_cls = type(obj)
         label = original_cls.__name__
+        tick = [0]
 
         def _record(target, name, mode):
+            tick[0] += 1
+            if sample_every > 1 and tick[0] % sample_every:
+                return
+            # the full held-lock set (every registered lock this
+            # thread holds right now) is what EL011's confirmation
+            # needs: two accesses race only if the sets are disjoint
+            held_labels = tuple(sorted(
+                {h.label for h in tracer._stack()}))
             tracer.events.append((
                 id(target), label, name, mode,
                 threading.get_ident(),
                 lock.held_by_current_thread(),
+                held_labels,
             ))
 
         namespace = {
@@ -195,7 +244,7 @@ class LockDisciplineTracer:
         """[(object label, attr, description)] for unsynchronized
         cross-thread access patterns observed so far."""
         per_attr = {}
-        for obj_id, label, name, mode, ident, held in self.events:
+        for obj_id, label, name, mode, ident, held, _hl in self.events:
             stats = per_attr.setdefault(
                 (obj_id, label, name),
                 {"threads": set(), "unlocked": set(),
@@ -229,6 +278,23 @@ class LockDisciplineTracer:
             raise AssertionError(
                 "unsynchronized cross-thread access:\n" + "\n".join(
                     "  %s.%s: %s" % p for p in problems))
+
+    # -- EL011 confirmation (sampled attribute-access records) ---------
+
+    def attr_access_records(self):
+        """[(class label, attr, mode, thread ident, held lock labels)]
+        — feed to ``el011_shared_state.RaceReport.merge_observed`` to
+        mark statically detected races ``confirmed``, exactly like
+        observed order edges confirm EL005 cycles."""
+        return [(label, name, mode, ident, held_labels)
+                for _oid, label, name, mode, ident, _h, held_labels
+                in self.events]
+
+    def race_confirmations(self):
+        """{(class label, attr)} for which this run OBSERVED a
+        read-modify-write and another thread's access with no common
+        held lock — a witnessed data race, not a static possibility."""
+        return confirmed_attr_keys(self.attr_access_records())
 
     # -- lock-order reporting ------------------------------------------
 
