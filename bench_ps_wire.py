@@ -100,7 +100,7 @@ class _Mode:
     interleaved timed blocks never share server state."""
 
     def __init__(self, label, wire_dtype, async_push_window, prefetch,
-                 rpc_delay_ms=0.0):
+                 rpc_delay_ms=0.0, frame_wire="auto"):
         from elasticdl_tpu.models import deepfm
         from elasticdl_tpu.worker.ps_client import PSClient
         from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
@@ -110,6 +110,7 @@ class _Mode:
         self.window = async_push_window
         self.prefetch = prefetch
         self.rpc_delay_ms = rpc_delay_ms
+        self.frame_wire = frame_wire
         self.procs, addrs = _start_ps(2, rpc_delay_ms=rpc_delay_ms)
         self.client = PSClient(
             _connect(addrs), wire_dtype=wire_dtype,
@@ -118,6 +119,7 @@ class _Mode:
             push_channels=(
                 _connect(addrs) if async_push_window > 0 else None
             ),
+            frame_wire=frame_wire,
         )
         spec = deepfm.model_spec(
             num_fields=NUM_FIELDS, vocab_size=VOCAB_SIZE,
@@ -157,10 +159,25 @@ class _Mode:
         return elapsed
 
     def result(self):
+        # wire_stats attributes payload bytes per ENCODING (the _pb /
+        # _frame split, PR 17); sum both so each per-step number covers
+        # the mode's whole wire regardless of which plane carried it,
+        # and report the decode-copy bytes — what frame-native RPCs
+        # exist to shrink (np.frombuffer views vs protobuf copy-out).
         stats = self.client.wire_stats
+        push_bytes = (stats["push_gradient_bytes_pb"]
+                      + stats["push_gradient_bytes_frame"])
+        pull_dense = (stats["pull_dense_bytes_pb"]
+                      + stats["pull_dense_bytes_frame"])
+        decode_copy = (stats["push_decode_copy_bytes_pb"]
+                       + stats["push_decode_copy_bytes_frame"]
+                       + stats["pull_dense_decode_copy_bytes_pb"]
+                       + stats["pull_dense_decode_copy_bytes_frame"])
         return {
             "mode": self.label,
             "wire_dtype": self.wire_dtype or "float32",
+            "frame_wire": self.frame_wire,
+            "frame_shards": self.client.frame_shards(),
             "async_push_window": self.window,
             "prefetch": bool(self.prefetch),
             "rpc_delay_ms": self.rpc_delay_ms,
@@ -169,12 +186,11 @@ class _Mode:
             "ms_per_step": round(
                 1000.0 * self.best_elapsed / ITERS, 2
             ),
-            "push_gradient_bytes_per_step":
-                stats["push_gradient_bytes"] // ITERS,
+            "push_gradient_bytes_per_step": push_bytes // ITERS,
             "pull_embedding_bytes_per_step":
                 stats["pull_embedding_bytes"] // ITERS,
-            "pull_dense_bytes_per_step":
-                stats["pull_dense_bytes"] // ITERS,
+            "pull_dense_bytes_per_step": pull_dense // ITERS,
+            "decode_copy_bytes_per_step": decode_copy // ITERS,
             "last_loss": float(self.last_loss),
             "overlap_counters": self.trainer.timing.counters(),
         }
@@ -207,6 +223,101 @@ def _run_pair(wire_dtype, tag, rpc_delay_ms=0.0):
         pipelined.close()
 
 
+def _run_frame_pair(wire_dtype, tag, rpc_delay_ms=0.0):
+    """Frame wire vs TensorPB wire, SAME everything else (pipelined
+    loop, same wire dtype, same seed/batches), interleaved blocks.
+    This is the PR-17 artifact: the only variable is whether push/pull
+    RPCs carry one frame blob (``frame_wire="on"``) or repeated
+    TensorPB messages (``"off"``)."""
+    pb_mode = _Mode("pb_" + tag, wire_dtype, 1, True,
+                    rpc_delay_ms=rpc_delay_ms, frame_wire="off")
+    frame_mode = _Mode("frame_" + tag, wire_dtype, 1, True,
+                       rpc_delay_ms=rpc_delay_ms, frame_wire="on")
+    try:
+        for _ in range(BLOCKS):
+            pb_mode.timed_block()
+            frame_mode.timed_block()
+        return pb_mode.result(), frame_mode.result()
+    finally:
+        pb_mode.close()
+        frame_mode.close()
+
+
+def _frame_bit_identity(wire_dtype):
+    """Same-seed SERIALIZED runs, pb wire vs frame wire: every loss
+    along the way must match bit for bit — any wire-path numerics
+    difference (encode rounding, decode upcast, tensor ordering)
+    surfaces here.  The serialized loop is used deliberately: the
+    pipelined loop is nondeterministic on ANY wire (async pushes race
+    embedding prefetches row-by-row, per-row atomicity by design), so
+    it cannot distinguish wire numerics from scheduling noise."""
+    pb_mode = _Mode("pb_bitid", wire_dtype, 0, False,
+                    frame_wire="off")
+    frame_mode = _Mode("frame_bitid", wire_dtype, 0, False,
+                       frame_wire="on")
+    try:
+        pb_losses, frame_losses = [], []
+        for k in range(ITERS):
+            pb_losses.append(float(pb_mode._step(k)[0]))
+            frame_losses.append(float(frame_mode._step(k)[0]))
+        return {
+            "bit_identical": pb_losses == frame_losses,
+            "steps_compared": ITERS,
+            "last_loss_pb": pb_losses[-1],
+            "last_loss_frame": frame_losses[-1],
+        }
+    finally:
+        pb_mode.close()
+        frame_mode.close()
+
+
+def _frame_gate(pb_loop, frame_loop, pb_net, frame_net, bitid,
+                rpc_delay_ms):
+    """The ``--frame`` acceptance artifact: decode-copy savings, wire
+    bytes, steps/s both at loopback and over the emulated cross-host
+    link, and bit-identity of the same-seed serialized losses."""
+    dc_ratio = (pb_loop["decode_copy_bytes_per_step"]
+                / max(1, frame_loop["decode_copy_bytes_per_step"]))
+    wire_ratio = (
+        (pb_loop["push_gradient_bytes_per_step"]
+         + pb_loop["pull_dense_bytes_per_step"])
+        / max(1, frame_loop["push_gradient_bytes_per_step"]
+              + frame_loop["pull_dense_bytes_per_step"])
+    )
+    loop_speed = (frame_loop["steps_per_sec"]
+                  / max(1e-9, pb_loop["steps_per_sec"]))
+    net_speed = (frame_net["steps_per_sec"]
+                 / max(1e-9, pb_net["steps_per_sec"]))
+    bit_identical = bool(bitid["bit_identical"])
+    return {
+        "metric": "ps_frame_wire",
+        "value": round(dc_ratio, 2),
+        "unit": "x fewer decode-copy bytes (frame vs TensorPB, equal "
+                "wire dtype)",
+        "vs_baseline": None,
+        "gates": {
+            "decode_copy_ratio_ge_1.3": dc_ratio >= 1.3,
+            "loopback_steps_ratio_ge_1.0": loop_speed >= 1.0,
+            "losses_bit_identical": bit_identical,
+        },
+        "pass": bool(dc_ratio >= 1.3 and loop_speed >= 1.0
+                     and bit_identical),
+        "detail": {
+            "decode_copy_bytes_ratio_pb_over_frame": round(
+                dc_ratio, 2),
+            "wire_bytes_ratio_pb_over_frame": round(wire_ratio, 3),
+            "steps_ratio_frame_over_pb_loopback": round(
+                loop_speed, 3),
+            "steps_ratio_frame_over_pb_xhost_%.0fms" % rpc_delay_ms:
+                round(net_speed, 3),
+            "bit_identity": bitid,
+            "baseline": "self-relative: the TensorPB wire IS the "
+                        "baseline, same pipelined loop and wire "
+                        "dtype on both legs",
+        },
+    }
+
+
 def main(argv=None):
     import argparse
 
@@ -218,12 +329,41 @@ def main(argv=None):
         help="emulated cross-host RPC latency for the overlap pair; "
              "the bytes pair always runs at loopback (0)",
     )
+    parser.add_argument(
+        "--frame", action="store_true",
+        help="also run the frame-vs-TensorPB pairs (loopback + "
+             "emulated cross-host) and print the ps_frame_wire gate",
+    )
+    parser.add_argument(
+        "--frame_only", action="store_true",
+        help="run ONLY the frame-vs-TensorPB leg (implies --frame); "
+             "what scripts/preflight.py invokes",
+    )
     args = parser.parse_args(argv)
+    if args.frame_only:
+        args.frame = True
 
     if os.environ.get("ELASTICDL_TPU_PLATFORM"):
         jax.config.update(
             "jax_platforms", os.environ["ELASTICDL_TPU_PLATFORM"]
         )
+    if args.frame:
+        # Frame-vs-TensorPB at equal (bf16) wire dtype: loopback shows
+        # the CPU-side decode/encode savings, the emulated cross-host
+        # leg shows the same ranking holds when the link dominates.
+        # bf16 is the honest dtype for the decode-copy gate — at f32
+        # the frame side's upcast cost is ZERO and the ratio diverges.
+        pb_loop, frame_loop = _run_frame_pair("bfloat16", "bf16_loop")
+        pb_net, frame_net = _run_frame_pair(
+            "bfloat16", "bf16_xhost", rpc_delay_ms=args.rpc_delay_ms)
+        bitid = _frame_bit_identity("bfloat16")
+        for r in (pb_loop, frame_loop, pb_net, frame_net):
+            print(json.dumps(r))
+        gate = _frame_gate(pb_loop, frame_loop, pb_net, frame_net,
+                           bitid, args.rpc_delay_ms)
+        print(json.dumps(gate))
+        if args.frame_only:
+            return 0 if gate["pass"] else 1
     # Pair 1 — loopback, f32 vs bf16 wire: the bytes-on-wire artifact,
     # plus the loopback overlap number (on a 2-core single-host rig the
     # worker, both PS shards, and XLA contend for the same cores, so
@@ -283,4 +423,4 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -71,6 +71,7 @@ os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 import numpy as np  # noqa: E402
 
+from elasticdl_tpu.client import frame_client as fc  # noqa: E402
 from elasticdl_tpu.utils import hist as hist_mod  # noqa: E402
 from elasticdl_tpu.utils import tensor_codec as tc  # noqa: E402
 
@@ -207,39 +208,30 @@ class _Rig:
                 conn.close()
 
         def http_bin_client(idx):
-            # The binary wire path: frame in, frame out, over the
-            # SAME keep-alive connection discipline.  The client does
-            # the honest equivalent work of the JSON client — encode
-            # once, decode every response into typed arrays.
+            # The binary wire path through the frame client SDK
+            # (client/frame_client.py) — the same keep-alive
+            # connection discipline as the JSON client, one pooled
+            # connection per thread.  Work parity with the JSON leg:
+            # encode once outside the loop (predict_frame replays the
+            # blob), decode every response into typed arrays.
             x = np.asarray(_payload(idx, self.payload_rows)
                            ["instances"], np.float32)
-            body = tc.encode_frame({"instances": x}, kind="predict")
-            headers = {"Content-Type": tc.FRAME_CONTENT_TYPE}
-            conn = http.client.HTTPConnection(
-                "127.0.0.1", self.port, timeout=120)
+            body = fc.encode_predict(x)
+            client = fc.FrameClient("127.0.0.1:%d" % self.port,
+                                    timeout=120, pool_size=1)
             try:
-                conn.request("POST", "/v1/models/mlp:predict",
-                             body=body, headers=headers)
-                conn.getresponse().read()  # warm
+                client.predict_frame("mlp", body)  # warm
                 barrier.wait()
                 for _ in range(requests_per_client):
                     t0 = time.perf_counter()
-                    conn.request("POST", "/v1/models/mlp:predict",
-                                 body=body, headers=headers)
-                    resp = conn.getresponse()
-                    raw = resp.read()
-                    if resp.status != 200:
-                        errors.append(raw[:200])
-                        return
-                    frame = tc.decode_frame(raw)
-                    tc.unflatten_tree(frame.meta["tree"],
-                                      frame.tensors)
+                    frame = client.predict_frame("mlp", body)
+                    fc.decode_predictions(frame)
                     latencies[idx].append(time.perf_counter() - t0)
             except Exception as e:  # noqa: BLE001
                 errors.append(repr(e))
                 barrier.abort()
             finally:
-                conn.close()
+                client.close()
 
         target = {"endpoint": endpoint_client,
                   "http": http_client,
@@ -761,20 +753,16 @@ def _run_router_passthrough(rig):
     )
 
     x = np.asarray(_payload(5)["instances"], np.float32)
-    blob = tc.encode_frame({"instances": x}, kind="predict",
-                           routing_key="bench-key")
-    headers = {"Content-Type": tc.FRAME_CONTENT_TYPE}
+    blob = fc.encode_predict(x, routing_key="bench-key")
 
     def post(port):
-        conn = http.client.HTTPConnection("127.0.0.1", port,
-                                          timeout=60)
-        try:
-            conn.request("POST", "/v1/models/mlp:predict", body=blob,
-                         headers=headers)
-            resp = conn.getresponse()
-            return resp.status, resp.read()
-        finally:
-            conn.close()
+        # roundtrip (not predict_frame): the check compares RAW reply
+        # bytes, which the typed surface would decode away.
+        with fc.FrameClient("127.0.0.1:%d" % port,
+                           timeout=60) as client:
+            status, _ctype, raw = client.roundtrip(
+                "/v1/models/mlp:predict", blob)
+            return status, raw
 
     router = Router(["127.0.0.1:%d" % rig.port], probe_interval=0.2)
     router.start()
@@ -881,20 +869,11 @@ def run_wire_bench(requests_per_client, max_batch_size,
             probe["instances"] = probe["instances"] * 3
             want = np.asarray(batched.predict_http_once(probe),
                               np.float32)
-            blob = tc.encode_frame(
-                {"instances": np.asarray(probe["instances"],
-                                         np.float32)},
-                kind="predict")
-            conn = http.client.HTTPConnection("127.0.0.1",
-                                              batched.port,
-                                              timeout=60)
-            conn.request("POST", "/v1/models/mlp:predict", body=blob,
-                         headers={"Content-Type":
-                                  tc.FRAME_CONTENT_TYPE})
-            resp = conn.getresponse()
-            frame = tc.decode_frame(resp.read())
-            conn.close()
-            got = tc.unflatten_tree(frame.meta["tree"], frame.tensors)
+            with fc.FrameClient("127.0.0.1:%d" % batched.port,
+                                timeout=60) as probe_client:
+                got = probe_client.predict(
+                    "mlp", np.asarray(probe["instances"],
+                                      np.float32))
             identical = bool(np.array_equal(want, got))
             if not identical:
                 raise SystemExit("binary predictions differ from JSON")

@@ -62,6 +62,15 @@ from elasticdl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+class ProgramMissingError(RuntimeError):
+    """A streamed ingest whose parameter tree is new to this
+    aggregator arrived WITHOUT an in-band StableHLO program and none
+    is cached — publishing it would fail.  The ingest endpoint maps
+    this to HTTP 422 so the exporter re-sends with
+    ``frame_bytes(include_program=True)`` (the re-prime handshake,
+    docs/serving.md "Streamed ingest")."""
+
+
 def _params_key(dense):
     """Program-cache key: {name: (shape, dtype)} over the dense tree —
     the StableHLO program depends on exactly this.  ONE definition:
@@ -129,6 +138,13 @@ class ModelAggregator:
         self._stats_lock = threading.Lock()
         self._counters = collections.Counter()
         self._freshness = None
+        # The aggregator body is single-threaded BY DESIGN (module
+        # docstring) — the streamed-ingest HTTP endpoint
+        # (aggregation/main.py IngestServer) is the one cross-thread
+        # mutator, so it and the control loop serialize on this lock.
+        # RLock: in-process callers driving ingest+publish from one
+        # thread (tests, the bench) take it re-entrantly for free.
+        self.loop_lock = threading.RLock()
         # The freshness SLO as a FIRST-CLASS rule (utils/slo.py): the
         # watchdog evaluates it on every publish — a breach emits the
         # ``slo.breach`` flight-recorder event and counts an episode;
@@ -155,16 +171,20 @@ class ModelAggregator:
             self._counters[name] += n
 
     def stats(self):
+        # Disjoint acquisitions (never nested the _stats_lock->
+        # loop_lock way): ingest_frame holds loop_lock around bump(),
+        # so the reverse nesting here would be a lock-order inversion.
+        with self.loop_lock:
+            last_ingested = self._last_ingested
+            last_published = self._last_published
+            window_fill = len(self._window)
         with self._stats_lock:
             counters = dict(self._counters)
             freshness = self._freshness
-        # The version/window fields are single-writer (the aggregator
-        # loop) and GIL-atomic to read — only the multi-writer
-        # counters and the freshness gauge need the lock.
         return {
-            "last_ingested_version": self._last_ingested,
-            "last_published_version": self._last_published,
-            "window_fill": len(self._window),
+            "last_ingested_version": last_ingested,
+            "last_published_version": last_published,
+            "window_fill": window_fill,
             "freshness_seconds": freshness,
             "freshness_slo_secs": self.freshness_slo_secs,
             "counters": counters,
@@ -188,43 +208,53 @@ class ModelAggregator:
         except OSError as e:
             logger.warning("source scan failed: %s", e)
             return []
-        # Bounded memory: once a version leaves the source base (the
-        # trainer's own retention), it leaves this set too — and the
-        # monotone high-water mark keeps a re-appearance unreachable.
-        self._ingested_set &= set(versions)
-        stale = [v for v in versions if v <= self._last_ingested
-                 and v not in self._ingested_set]
-        if stale:
-            # Out-of-order arrivals from a re-formed world: counted
-            # ONCE (added to the set below), never ingested.
-            self._ingested_set.update(stale)
-            self.bump("stale_exports_skipped", len(stale))
-        ingested = []
-        for version in versions:
-            if version <= self._last_ingested:
-                continue
-            export_dir = os.path.join(self.source_dir, str(version))
-            with tracing.span("agg.ingest", version=version):
-                try:
-                    dense, embeddings = load_payload(export_dir)
-                    born_at = os.path.getmtime(
-                        os.path.join(export_dir, "manifest.json"))
-                except (OSError, ValueError, KeyError) as e:
-                    # A GC'd or unreadable export: skip loudly; the
-                    # next trainer cadence brings a fresh one.
-                    logger.warning("ingest of version %d failed: %s",
-                                   version, e)
-                    self.bump("ingest_errors")
+        # The window/high-water state is shared with the streamed
+        # ingest thread (IngestServer) — every touch below serializes
+        # on loop_lock, re-entrantly free for the control loop that
+        # already holds it.
+        with self.loop_lock:
+            # Bounded memory: once a version leaves the source base
+            # (the trainer's own retention), it leaves this set too —
+            # and the monotone high-water mark keeps a re-appearance
+            # unreachable.
+            self._ingested_set &= set(versions)
+            stale = [v for v in versions if v <= self._last_ingested
+                     and v not in self._ingested_set]
+            if stale:
+                # Out-of-order arrivals from a re-formed world:
+                # counted ONCE (added to the set below), never
+                # ingested.
+                self._ingested_set.update(stale)
+                self.bump("stale_exports_skipped", len(stale))
+            ingested = []
+            for version in versions:
+                if version <= self._last_ingested:
                     continue
-                self._window.append(_Ingest(
-                    version, dense, embeddings, export_dir, born_at))
-                self._last_ingested = version
-                self._ingested_set.add(version)
-                ingested.append(version)
-                self.bump("ingested")
+                export_dir = os.path.join(self.source_dir,
+                                          str(version))
+                with tracing.span("agg.ingest", version=version):
+                    try:
+                        dense, embeddings = load_payload(export_dir)
+                        born_at = os.path.getmtime(
+                            os.path.join(export_dir, "manifest.json"))
+                    except (OSError, ValueError, KeyError) as e:
+                        # A GC'd or unreadable export: skip loudly;
+                        # the next trainer cadence brings a fresh one.
+                        logger.warning(
+                            "ingest of version %d failed: %s",
+                            version, e)
+                        self.bump("ingest_errors")
+                        continue
+                    self._window.append(_Ingest(
+                        version, dense, embeddings, export_dir,
+                        born_at))
+                    self._last_ingested = version
+                    self._ingested_set.add(version)
+                    ingested.append(version)
+                    self.bump("ingested")
         return ingested
 
-    def ingest_frame(self, blob, born_at=None):
+    def ingest_frame(self, blob, born_at=None, require_program=False):
         """STREAMED ingest: one servable frame
         (``serving.export.servable_frame_bytes`` /
         ``ContinuousExporter.frame_bytes``) hands a trainer version to
@@ -237,31 +267,50 @@ class ModelAggregator:
         program (present on first export / tree change) is cached for
         publishing; a malformed frame raises
         :class:`~elasticdl_tpu.utils.tensor_codec.FrameError` loudly.
-        Returns the ingested version, or None when skipped."""
+        Returns the ingested version, or None when skipped.
+
+        ``require_program=True`` (the HTTP ingest endpoint's mode)
+        refuses a program-less frame whose parameter tree has no
+        cached program with :class:`ProgramMissingError` AT INGEST —
+        a cross-host exporter must learn it needs to re-prime NOW
+        (HTTP 422), not when a later publish fails server-side.  The
+        default stays lax for in-process callers that prime out of
+        band."""
         from elasticdl_tpu.serving.export import servable_from_frame
 
         dense, embeddings, manifest, program = servable_from_frame(
             blob)
         version = int(manifest.get("version", 0) or 0)
-        if version <= self._last_ingested:
-            self.bump("stale_exports_skipped")
-            return None
-        with tracing.span("agg.ingest", version=version,
-                          streamed=True):
-            if program is not None:
-                # Cache the in-band program AT INGEST: a priming frame
-                # superseded in the window before any publish must not
-                # take the program down with it.
-                self._program = program
-                self._program_params = _params_key(dense)
-            self._window.append(_Ingest(
-                version, dense, embeddings, None,
-                time.time() if born_at is None else born_at,
-                manifest=manifest, program=program))
-            self._last_ingested = version
-            self._ingested_set.add(version)
-            self.bump("ingested")
-            self.bump("ingested_frames")
+        with self.loop_lock:
+            if version <= self._last_ingested:
+                self.bump("stale_exports_skipped")
+                return None
+            if (require_program and program is None
+                    and (self._program is None
+                         or _params_key(dense)
+                         != self._program_params)):
+                self.bump("program_missing_rejected")
+                raise ProgramMissingError(
+                    "streamed ingest of version %d carries no "
+                    "StableHLO program and none is cached for this "
+                    "parameter tree; re-send with "
+                    "frame_bytes(include_program=True)" % version)
+            with tracing.span("agg.ingest", version=version,
+                              streamed=True):
+                if program is not None:
+                    # Cache the in-band program AT INGEST: a priming
+                    # frame superseded in the window before any
+                    # publish must not take the program down with it.
+                    self._program = program
+                    self._program_params = _params_key(dense)
+                self._window.append(_Ingest(
+                    version, dense, embeddings, None,
+                    time.time() if born_at is None else born_at,
+                    manifest=manifest, program=program))
+                self._last_ingested = version
+                self._ingested_set.add(version)
+                self.bump("ingested")
+                self.bump("ingested_frames")
         return version
 
     # -- aggregate -----------------------------------------------------
@@ -277,12 +326,15 @@ class ModelAggregator:
         the newest export.  Embeddings always ride from the newest —
         averaging sparse rows that may not exist in every export would
         fabricate values."""
-        if not self._window:
-            raise RuntimeError("nothing ingested yet")
-        newest = self._window[-1]
-        if self.mode == "latest" or len(self._window) == 1:
-            return dict(newest.dense)
-        members = list(self._window)
+        with self.loop_lock:
+            if not self._window:
+                raise RuntimeError("nothing ingested yet")
+            newest = self._window[-1]
+            if self.mode == "latest" or len(self._window) == 1:
+                return dict(newest.dense)
+            # Snapshot under the lock; the combine below reads only
+            # the (immutable) _Ingest members.
+            members = list(self._window)
         if self.mode == "ema":
             weights = [self.ema_decay ** (len(members) - 1 - i)
                        for i in range(len(members))]
@@ -315,14 +367,15 @@ class ModelAggregator:
 
     def publish_due(self, now=None):
         """A new ingest is waiting and the publish throttle allows."""
-        if not self._window or self._last_ingested <= \
-                self._last_published:
-            return False
-        if self._last_publish_at is None:
-            return True
-        now = time.monotonic() if now is None else now
-        return (now - self._last_publish_at
-                >= self.min_publish_interval_secs)
+        with self.loop_lock:
+            if not self._window or self._last_ingested <= \
+                    self._last_published:
+                return False
+            if self._last_publish_at is None:
+                return True
+            now = time.monotonic() if now is None else now
+            return (now - self._last_publish_at
+                    >= self.min_publish_interval_secs)
 
     def publish(self):
         """Write the aggregated servable as
@@ -330,57 +383,65 @@ class ModelAggregator:
         (version, freshness_seconds): freshness is publish wall time
         minus the newest source export's birth time — the number the
         SLO constrains and /metrics exports."""
-        newest = self._window[-1]
-        version = newest.version
-        dst = os.path.join(self.publish_dir, str(version))
-        if os.path.isfile(os.path.join(dst, "manifest.json")):
-            # A restarted aggregator replaying its ingest state:
-            # version already published (complete versions are
-            # immutable — rewriting one would ride the non-atomic
-            # swap path over a dir the fleet may have committed).
+        # Held for the whole publish: a streamed ingest landing
+        # mid-publish must not rotate the window out from under the
+        # aggregate (loop_lock is re-entrant for the control loop).
+        with self.loop_lock:
+            newest = self._window[-1]
+            version = newest.version
+            dst = os.path.join(self.publish_dir, str(version))
+            if os.path.isfile(os.path.join(dst, "manifest.json")):
+                # A restarted aggregator replaying its ingest state:
+                # version already published (complete versions are
+                # immutable — rewriting one would ride the non-atomic
+                # swap path over a dir the fleet may have committed).
+                self._last_published = version
+                self._last_publish_at = time.monotonic()
+                self.bump("republish_skipped")
+                logger.info("version %d already published; skipped",
+                            version)
+                return version, max(0.0,
+                                    time.time() - newest.born_at)
+            with tracing.span("agg.publish", version=version,
+                              window=len(self._window),
+                              mode=self.mode):
+                dense = self.aggregated_dense()
+                program, manifest = self._program_for(newest)
+                manifest = dict(
+                    manifest, version=version,
+                    model_name=self.model_name
+                    or manifest.get("model_name", ""),
+                )
+                manifest["aggregation"] = {
+                    "mode": self.mode,
+                    "window": len(self._window),
+                    "source_versions": [i.version
+                                        for i in self._window],
+                    "ema_decay": (self.ema_decay
+                                  if self.mode == "ema" else None),
+                }
+                payload = dict(dense)
+                for name, (ids, values) in newest.embeddings.items():
+                    payload["emb_ids/" + name] = ids
+                    payload["emb_vals/" + name] = np.asarray(values)
+                # The aggregate is plain f32 — strip any int8 storage
+                # prefix the SOURCE manifest carried (quantized
+                # trainer exports decode at ingest; the published npz
+                # holds full weights).
+                fmt = manifest.get("format", "")
+                manifest["format"] = fmt.split("+")[-1]
+                manifest["quantized_int8"] = []
+                publish_export(
+                    os.path.join(self.publish_dir, str(version)), {
+                        "model.npz": _npz_bytes(payload),
+                        "model.stablehlo": program,
+                        "manifest.json": json.dumps(
+                            manifest, indent=2).encode(),
+                    })
+            freshness = max(0.0, time.time() - newest.born_at)
             self._last_published = version
             self._last_publish_at = time.monotonic()
-            self.bump("republish_skipped")
-            logger.info("version %d already published; skipped",
-                        version)
-            return version, max(0.0, time.time() - newest.born_at)
-        with tracing.span("agg.publish", version=version,
-                          window=len(self._window), mode=self.mode):
-            dense = self.aggregated_dense()
-            program, manifest = self._program_for(newest)
-            manifest = dict(
-                manifest, version=version,
-                model_name=self.model_name
-                or manifest.get("model_name", ""),
-            )
-            manifest["aggregation"] = {
-                "mode": self.mode,
-                "window": len(self._window),
-                "source_versions": [i.version for i in self._window],
-                "ema_decay": (self.ema_decay if self.mode == "ema"
-                              else None),
-            }
-            payload = dict(dense)
-            for name, (ids, values) in newest.embeddings.items():
-                payload["emb_ids/" + name] = ids
-                payload["emb_vals/" + name] = np.asarray(values)
-            # The aggregate is plain f32 — strip any int8 storage
-            # prefix the SOURCE manifest carried (quantized trainer
-            # exports decode at ingest; the published npz holds full
-            # weights).
-            fmt = manifest.get("format", "")
-            manifest["format"] = fmt.split("+")[-1]
-            manifest["quantized_int8"] = []
-            publish_export(
-                os.path.join(self.publish_dir, str(version)), {
-                    "model.npz": _npz_bytes(payload),
-                    "model.stablehlo": program,
-                    "manifest.json": json.dumps(
-                        manifest, indent=2).encode(),
-                })
-        freshness = max(0.0, time.time() - newest.born_at)
-        self._last_published = version
-        self._last_publish_at = time.monotonic()
+            window_fill = len(self._window)
         with self._stats_lock:
             self._freshness = freshness
             self._counters["published"] += 1
@@ -397,7 +458,7 @@ class ModelAggregator:
                 version)
         logger.info("published aggregated version %d (window %d, "
                     "mode %s, freshness %.2fs)", version,
-                    len(self._window), self.mode, freshness)
+                    window_fill, self.mode, freshness)
         return version, freshness
 
     def _program_for(self, ingest):
@@ -415,29 +476,33 @@ class ModelAggregator:
         loudly here — the exporter re-primes with
         ``frame_bytes(include_program=True)``."""
         params_key = _params_key(ingest.dense)
-        if ingest.export_dir is None:
-            manifest = dict(ingest.manifest)
-            if ingest.program is not None:
-                self._program = ingest.program
-                self._program_params = params_key
-            elif (self._program is None
-                  or params_key != self._program_params):
-                raise RuntimeError(
-                    "streamed ingest of version %d carries no "
-                    "StableHLO program and none is cached for this "
-                    "parameter tree; re-send with "
-                    "frame_bytes(include_program=True)"
-                    % ingest.version)
-            return self._program, manifest
-        with open(os.path.join(ingest.export_dir,
-                               "manifest.json")) as f:
-            manifest = json.load(f)
-        if self._program is None or params_key != self._program_params:
+        # The program cache is primed from the streamed-ingest thread
+        # too (ingest_frame) — serialize on the same lock.
+        with self.loop_lock:
+            if ingest.export_dir is None:
+                manifest = dict(ingest.manifest)
+                if ingest.program is not None:
+                    self._program = ingest.program
+                    self._program_params = params_key
+                elif (self._program is None
+                      or params_key != self._program_params):
+                    raise RuntimeError(
+                        "streamed ingest of version %d carries no "
+                        "StableHLO program and none is cached for "
+                        "this parameter tree; re-send with "
+                        "frame_bytes(include_program=True)"
+                        % ingest.version)
+                return self._program, manifest
             with open(os.path.join(ingest.export_dir,
-                                   "model.stablehlo"), "rb") as f:
-                self._program = f.read()
-            self._program_params = params_key
-        return self._program, manifest
+                                   "manifest.json")) as f:
+                manifest = json.load(f)
+            if (self._program is None
+                    or params_key != self._program_params):
+                with open(os.path.join(ingest.export_dir,
+                                       "model.stablehlo"), "rb") as f:
+                    self._program = f.read()
+                self._program_params = params_key
+            return self._program, manifest
 
     # -- retention -----------------------------------------------------
 

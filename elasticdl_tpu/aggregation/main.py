@@ -24,15 +24,143 @@ Run:
 """
 
 import http.client
+import json
 import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from elasticdl_tpu.aggregation.aggregator import ModelAggregator
+from elasticdl_tpu.aggregation.aggregator import (
+    ModelAggregator,
+    ProgramMissingError,
+)
 from elasticdl_tpu.serving.fleet import http_get_json, http_post_json
-from elasticdl_tpu.utils import tracing
+from elasticdl_tpu.utils import tensor_codec, tracing
 from elasticdl_tpu.utils.args import build_aggregator_parser
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+# The ingest endpoint refuses bodies whose declared length exceeds
+# this — a lying Content-Length must not balloon the daemon before the
+# codec even sees the bytes (the frame preamble re-checks the real
+# length anyway).
+INGEST_MAX_BYTES = 1 << 31
+
+
+class IngestServer:
+    """The aggregator's cross-host streamed-ingest surface.
+
+      POST /ingest   -> one ``model.frame`` blob
+                        (``ContinuousExporter.frame_bytes``); replies
+                        200 {"ingested": version} on success
+      GET  /healthz  -> 200 ok
+      GET  /status   -> the aggregator's ``stats()`` JSON
+
+    Rejections map to DISTINCT statuses because the exporter's
+    recovery differs per cause (docs/serving.md "Streamed ingest"):
+
+      400  malformed frame (codec ``FrameError``) — a bug or hostile
+           peer; the body is discarded loudly, never partially applied
+      409  stale version (the version-monotone rule) — skip; a
+           re-formed elastic world double-sent an old cadence
+      415  not the frame content type — this endpoint speaks only the
+           binary wire
+      422  program missing — the frame's parameter tree is new here
+           and no StableHLO program rode along (this aggregator
+           restarted and lost its cache); the exporter re-sends with
+           ``include_program=True``
+
+    Ingest runs on the HTTP thread but mutates the aggregator under
+    ``agg.loop_lock`` (inside ``ingest_frame``), serializing against
+    the control loop — the single-threaded-aggregator design holds
+    with this surface attached.  This is the real three-host topology:
+    trainer and aggregator share no filesystem; versions arrive ONLY
+    through this endpoint."""
+
+    def __init__(self, agg, port=0, host="0.0.0.0"):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # keep-alive for streams
+
+            def log_message(self, fmt, *args):
+                logger.debug("ingest: " + fmt, *args)
+
+            def _reply(self, code, payload, close=False):
+                # ``close``: a POST rejected BEFORE its body was read
+                # leaves the unread bytes in the keep-alive stream —
+                # the next pipelined request would parse mid-body.
+                # Those rejections tear the connection down instead.
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if close:
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, {"ok": True})
+                if self.path == "/status":
+                    return self._reply(200, agg.stats())
+                return self._reply(404, {"error": "unknown path %s"
+                                         % self.path})
+
+            def do_POST(self):
+                if self.path != "/ingest":
+                    return self._reply(404, {"error": "unknown path "
+                                             "%s" % self.path},
+                                       close=True)
+                if not tensor_codec.is_frame_content_type(
+                        self.headers.get("Content-Type", "")):
+                    return self._reply(415, {
+                        "error": "POST /ingest takes %s bodies"
+                        % tensor_codec.FRAME_CONTENT_TYPE},
+                        close=True)
+                try:
+                    length = int(self.headers.get("Content-Length",
+                                                  0))
+                except ValueError:
+                    length = -1
+                if not 0 < length <= INGEST_MAX_BYTES:
+                    return self._reply(400, {
+                        "error": "bad Content-Length %r"
+                        % self.headers.get("Content-Length")},
+                        close=True)
+                blob = self.rfile.read(length)
+                try:
+                    version = agg.ingest_frame(blob,
+                                               require_program=True)
+                except tensor_codec.FrameError as e:
+                    agg.bump("ingest_frame_rejected")
+                    logger.warning("ingest refused a bad frame: %s",
+                                   e)
+                    return self._reply(400, {"error": "bad frame: %s"
+                                             % e})
+                except ProgramMissingError as e:
+                    logger.warning("ingest needs a program: %s", e)
+                    return self._reply(422, {"error": str(e)})
+                if version is None:
+                    return self._reply(409, {
+                        "error": "stale version (monotone ingest)",
+                        "last_ingested":
+                            agg.stats()["last_ingested_version"]})
+                return self._reply(200, {"ingested": version})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="ingest-http",
+            daemon=True)
+
+    def start(self):
+        self._thread.start()
+        logger.info("streamed-ingest endpoint on port %d "
+                    "(POST /ingest)", self.port)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
 
 # Everything a dying/garbled router can throw at this client: OSError
 # covers refusals and non-200s (http_post_json raises it), ValueError
@@ -181,12 +309,19 @@ def drive_rollout(router, version, freshness=None,
 def run_loop(agg, stop_event, router=None, poll_interval=1.0,
              canary_fraction=0.0, canary_soak_secs=10.0,
              canary_max_error_ratio=0.02):
-    """The aggregation tier's control loop (see module docstring)."""
+    """The aggregation tier's control loop (see module docstring).
+
+    Aggregator mutations run under ``agg.loop_lock`` — the streamed-
+    ingest HTTP endpoint shares the aggregator from its own threads —
+    but never across the fleet drive: a 300 s rollout must not starve
+    ingest."""
     while not stop_event.is_set():
-        agg.ingest_once()
+        with agg.loop_lock:
+            agg.ingest_once()
         if agg.publish_due():
             try:
-                version, freshness = agg.publish()
+                with agg.loop_lock:
+                    version, freshness = agg.publish()
             except (OSError, RuntimeError) as e:
                 logger.warning("publish failed: %s", e)
                 agg.bump("publish_errors")
@@ -227,6 +362,10 @@ def main(argv=None):
     )
     router = (RouterClient(args.router_addr) if args.router_addr
               else None)
+    ingest_server = None
+    if args.ingest_port >= 0:
+        ingest_server = IngestServer(agg, port=args.ingest_port)
+        ingest_server.start()
     stop = threading.Event()
 
     def on_term(_signum, _frame):
@@ -250,6 +389,8 @@ def main(argv=None):
                  canary_max_error_ratio=args.canary_max_error_ratio)
     except KeyboardInterrupt:
         pass
+    if ingest_server is not None:
+        ingest_server.stop()
     logger.info("aggregation tier stopping: %s", agg.stats())
     return 0
 

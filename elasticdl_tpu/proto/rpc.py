@@ -13,6 +13,25 @@ import grpc
 from elasticdl_tpu.proto import elastic_pb2 as pb
 from elasticdl_tpu.utils import tracing
 
+
+class RawFrame:
+    """Identity codec for raw-frame RPC slots (docs/ps_pipeline.md
+    "Frame wire"): the serialized gRPC message IS the tensor_codec
+    frame blob.  Registered in a method table exactly like a protobuf
+    class — ``SerializeToString``/``FromString`` are the only contract
+    the stub/servicer plumbing below uses — but both are the identity,
+    so no protobuf envelope ever touches the hot payload and the
+    receiver's ``decode_frame`` views alias the wire bytes directly."""
+
+    @staticmethod
+    def SerializeToString(data):  # noqa: N802 — protobuf API shape
+        return bytes(data)
+
+    @staticmethod
+    def FromString(data):  # noqa: N802 — protobuf API shape
+        return data
+
+
 # service name -> {method name: (request class, response class)}
 SERVICES = {
     "elasticdl_tpu.Master": {
@@ -34,6 +53,18 @@ SERVICES = {
         ),
         "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.TensorPB),
         "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+        # Frame-native data plane (negotiated via
+        # PullDenseParametersResponse.frame_capable, per-shard): the
+        # request/response frame slots use the RawFrame identity codec,
+        # so the gradient table / dense params ride as ONE zero-copy
+        # frame blob per RPC.  Generation fencing reads the frame
+        # header's meta, so a dead incarnation's push is still rejected
+        # before any payload decode.
+        "push_gradients_frame": (RawFrame, pb.PushGradientsResponse),
+        "pull_dense_parameters_frame": (
+            pb.PullDenseParametersRequest,
+            RawFrame,
+        ),
         "prepare_gradients": (
             pb.PrepareGradientsRequest,
             pb.PushGradientsResponse,

@@ -10,7 +10,7 @@ import threading
 import numpy as np
 
 from elasticdl_tpu.native.bindings import NativeEmbeddingTable
-from elasticdl_tpu.utils import tensor_codec
+from elasticdl_tpu.utils import hashing, tensor_codec
 from elasticdl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -79,8 +79,13 @@ class Parameters:
                 kwargs = {"init_a": -0.05, "init_b": 0.05}
             elif initializer == "normal":
                 kwargs = {"init_a": 0.0, "init_b": 0.05}
+            # Stable hash, NOT builtin hash(): str hashing is
+            # randomized per process, which made lazy-row init differ
+            # across shard restarts (and made same-seed runs
+            # irreproducible across PS processes).
             self.embeddings[name] = NativeEmbeddingTable(
-                info["dim"], initializer, seed=hash(name) & 0xFFFF,
+                info["dim"], initializer,
+                seed=hashing.string_to_id(name, 0x10000),
                 **kwargs,
             )
 

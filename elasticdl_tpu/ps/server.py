@@ -198,6 +198,10 @@ class ParameterServer:
                     "durable_version": self.servicer.durable_version,
                     "initialized": self.parameters.initialized,
                     "counters": dict(self.servicer.counters),
+                    # Per-encoding data-plane byte accounting (frame
+                    # vs pb payload + decode-copy bytes) — the
+                    # frame-wire bench's server-side artifact.
+                    "wire": dict(self.servicer.wire_counters),
                     # Push/pull handle-time histograms: rendered
                     # natively by utils/prom.ps_to_prometheus (the one
                     # renderer home — the inline renderer that used to

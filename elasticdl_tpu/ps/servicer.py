@@ -86,6 +86,18 @@ class PserverServicer:
                          "push_gen_rejected": 0, "ps_ckpt_failed": 0,
                          "pull_dense": 0, "pull_embedding": 0,
                          "pull_embedding_ro": 0}
+        # Data-plane byte accounting per wire encoding (the frame-vs-pb
+        # bench artifact, surfaced as elasticdl_ps_wire_bytes{kind=} on
+        # /metrics): payload bytes received/sent, plus the bytes the
+        # decode path had to COPY to produce consumable ndarrays —
+        # zero-copy frames alias the gRPC message, TensorPB pays a full
+        # content materialization per tensor (tensor_codec decode-copy
+        # accounting).  Bumped under self._lock like the counters.
+        self.wire_counters = {
+            "push_payload_pb": 0, "push_payload_frame": 0,
+            "push_decode_copy_pb": 0, "push_decode_copy_frame": 0,
+            "pull_dense_payload_pb": 0, "pull_dense_payload_frame": 0,
+        }
         # Handle-time histograms for the data-plane RPCs (push/pull),
         # rendered as native Prometheus histograms on the shard's
         # /metrics (utils/prom.ps_to_prometheus).  Durations use local
@@ -125,6 +137,10 @@ class PserverServicer:
     def _pull_dense_parameters(self, request):
         res = pb.PullDenseParametersResponse()
         res.generation = self.generation
+        # Advertise the raw-frame data plane: a capable client upgrades
+        # this shard's push/pull traffic to the *_frame methods after
+        # its first legacy pull (docs/ps_pipeline.md "Frame wire").
+        res.frame_capable = True
         # A client that last observed a different incarnation gets the
         # full dense state regardless of its version: after a crash-
         # restore rollback the server's version is BELOW the client's,
@@ -149,7 +165,50 @@ class PserverServicer:
                     tensor_codec.ndarray_to_pb(
                         arr, out=res.dense_parameters[name]
                     )
+            self.wire_counters["pull_dense_payload_pb"] += (
+                res.ByteSize()
+            )
         return res
+
+    @rpc_error_guard
+    def pull_dense_parameters_frame(self, request, _context=None):
+        """Frame-native dense pull (docs/ps_pipeline.md "Frame wire"):
+        same request/fast-path/fencing semantics as the pb method, but
+        the response is ONE params frame blob (RawFrame identity codec)
+        instead of repeated per-tensor TensorPB copies.  The
+        not-modified fast path is a tensorless frame whose header meta
+        still carries initialized/version/generation."""
+        t0 = time.perf_counter()
+        try:
+            return self._pull_dense_parameters_frame(request)
+        finally:
+            self.timing.observe("ps.pull_dense",
+                                time.perf_counter() - t0)
+
+    def _pull_dense_parameters_frame(self, request):
+        stale_gen = bool(request.generation) and (
+            request.generation != self.generation
+        )
+        with self._lock:
+            self.counters["pull_dense"] += 1
+            initialized = self._params.initialized
+            version = self._params.version
+            dense = None
+            if initialized and (
+                request.version < version
+                or request.version < 0
+                or stale_gen
+            ):
+                dense = self._params.get_dense()
+            # Encode UNDER the lock: encode_frame reads the parameter
+            # buffers (tobytes), and a concurrent in-place apply would
+            # tear them — the same reason the pb path encodes under it.
+            blob = tensor_codec.encode_params_frame(
+                dense, version=version, initialized=initialized,
+                generation=self.generation,
+            )
+            self.wire_counters["pull_dense_payload_frame"] += len(blob)
+        return blob
 
     @rpc_error_guard
     def pull_embedding_vectors(self, request, _context=None):
@@ -243,9 +302,67 @@ class PserverServicer:
         dense, embeddings, _, grad_version = tensor_codec.pb_to_model(
             request.gradients
         )
-        lr_override = request.learning_rate or None
+        return self._handle_push(
+            dense, embeddings, grad_version,
+            request.learning_rate or None,
+            wire=("pb", request.gradients.ByteSize(),
+                  tensor_codec.model_pb_decode_copy_bytes(
+                      request.gradients)),
+        )
+
+    @rpc_error_guard
+    def push_gradients_frame(self, request, _context=None):
+        """Frame-native gradient push (docs/ps_pipeline.md "Frame
+        wire"): ``request`` IS the frame blob (RawFrame identity
+        codec).  Fencing reads ``generation`` from the PEEKED header
+        meta, so a push stamped by a dead incarnation is rejected
+        before any payload decode; the decode itself hands back
+        zero-copy views over the gRPC message bytes, fed straight into
+        the same apply path as the pb method.  A malformed blob raises
+        FrameError, which rpc_error_guard surfaces as a loud
+        INVALID_ARGUMENT with the server intact."""
+        t0 = time.perf_counter()
+        try:
+            header = tensor_codec.peek_frame_header(request)
+            generation = tensor_codec.frame_meta(header).get(
+                "generation") or 0
+            if not isinstance(generation, int):
+                raise tensor_codec.FrameError(
+                    "meta generation %r is not an integer"
+                    % (generation,))
+            fenced = self._fence(generation)
+            if fenced is not None:
+                return fenced
+            dense, embeddings, grad_version, lr = (
+                tensor_codec.decode_grads_frame(request)
+            )
+            return self._handle_push(
+                dense, embeddings, grad_version, lr or None,
+                wire=("frame", len(request),
+                      tensor_codec.frame_decode_copy_bytes(header)),
+            )
+        finally:
+            self.timing.observe("ps.push_handle",
+                                time.perf_counter() - t0)
+
+    def _handle_push(self, dense, embeddings, grad_version, lr_override,
+                     wire=None):
+        """Decoded-gradient apply shared by the pb and frame push
+        paths — one body, so the two wire encodings stay bit-identical
+        in everything that matters (staleness checks, lr modulation,
+        sync buffering, version/report bookkeeping).  ``wire`` is the
+        (encoding, payload_bytes, decode_copy_bytes) accounting triple,
+        folded into ``wire_counters`` under the lock."""
         report = None
         with self._lock:
+            if wire is not None:
+                encoding, payload_bytes, copy_bytes = wire
+                self.wire_counters["push_payload_" + encoding] += (
+                    payload_bytes
+                )
+                self.wire_counters["push_decode_copy_" + encoding] += (
+                    copy_bytes
+                )
             if self._use_async:
                 lr_mult = 1.0
                 if self._lr_staleness_modulation:

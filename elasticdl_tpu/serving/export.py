@@ -542,6 +542,8 @@ class ContinuousExporter:
         self._signature = None
         self._out_signature = None
         self.exports = 0
+        # stream_to outcomes (the cross-host push hook's telemetry)
+        self.stream_stats = {"pushed": 0, "stale": 0, "reprimed": 0}
 
     def _key(self, flat):
         return {n: (tuple(np.shape(v)), str(np.asarray(v).dtype))
@@ -638,6 +640,49 @@ class ContinuousExporter:
             program=self._program if include_program else None)
         self.exports += 1
         return blob
+
+    def stream_to(self, client, version, apply_fn, params,
+                  example_input, embeddings=None):
+        """Push one version to an aggregator's streamed-ingest
+        endpoint (``POST /ingest``, aggregation/main.py) through a
+        :class:`~elasticdl_tpu.client.frame_client.FrameClient` — the
+        trainer-side hook of the real three-host topology: trainer and
+        aggregator share no filesystem, versions travel only as frame
+        blobs.
+
+        Returns the ingested version, or None when the aggregator
+        already had this version or newer (its version-monotone 409 —
+        a re-formed world double-exporting an old cadence; counted,
+        never an error).  A 422 (the aggregator restarted mid-stream
+        and lost its program cache) RE-PRIMES automatically: the same
+        version is re-sent with the StableHLO program in-band — no
+        trainer intervention, the acceptance drill of
+        docs/serving.md "Streamed ingest".  Malformed-frame 400s and
+        transport failures propagate: they mean a bug, not a protocol
+        state."""
+        from elasticdl_tpu.client.frame_client import (
+            ProgramRequiredError,
+            StaleVersionError,
+        )
+
+        blob = self.frame_bytes(version, apply_fn, params,
+                                example_input, embeddings=embeddings)
+        try:
+            try:
+                ingested = client.ingest(blob)
+            except ProgramRequiredError:
+                logger.info(
+                    "aggregator lost its program cache; re-priming "
+                    "version %d with the program in-band", version)
+                self.stream_stats["reprimed"] += 1
+                ingested = client.ingest(self.frame_bytes(
+                    version, apply_fn, params, example_input,
+                    embeddings=embeddings, include_program=True))
+        except StaleVersionError:
+            self.stream_stats["stale"] += 1
+            return None
+        self.stream_stats["pushed"] += 1
+        return ingested
 
     def _gc(self):
         """Source-base retention: continuous export mints versions

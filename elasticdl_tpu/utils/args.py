@@ -496,6 +496,13 @@ def build_aggregator_parser():
                         help="canary error budget over the soak "
                              "window; above it the canary is rolled "
                              "back instead of promoted")
+    parser.add_argument("--ingest_port", type=int, default=-1,
+                        help="streamed-ingest HTTP endpoint (POST "
+                             "/ingest takes model.frame blobs from "
+                             "the trainer's ContinuousExporter — the "
+                             "cross-host path needing no shared "
+                             "filesystem); 0 picks a free port, -1 "
+                             "disables (filesystem ingest only)")
     return parser
 
 

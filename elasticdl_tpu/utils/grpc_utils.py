@@ -19,6 +19,7 @@ import grpc
 
 from elasticdl_tpu.utils import tracing
 from elasticdl_tpu.utils.logging import get_logger
+from elasticdl_tpu.utils.tensor_codec import FrameError
 
 logger = get_logger(__name__)
 
@@ -40,6 +41,22 @@ def rpc_error_guard(method):
     def wrapper(self, request, context=None):
         try:
             return method(self, request, context)
+        except FrameError as e:
+            # A malformed frame on the raw-frame data plane is the
+            # CLIENT's fault: surface it as INVALID_ARGUMENT (the
+            # HTTP-400 analog), never INTERNAL, so a hostile or
+            # truncated blob reads as "your frame is bad" and the
+            # server keeps serving (docs/ps_pipeline.md "Frame wire").
+            logger.warning(
+                "servicer %s.%s refused a bad frame: %s",
+                type(self).__name__, method.__name__, e,
+            )
+            if context is not None:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "bad frame: %s" % e,
+                )
+            raise
         except Exception as e:
             logger.exception(
                 "servicer %s.%s failed",

@@ -96,6 +96,9 @@ METRICS = {
     "elasticdl_ps_durable_version": _G("last version durably on disk"),
     "elasticdl_ps_initialized": _G("1 once parameters initialized"),
     "elasticdl_ps_requests": _G("data-plane request counters {kind=}"),
+    "elasticdl_ps_wire_bytes": _G(
+        "data-plane payload + decode-copy bytes per wire encoding "
+        "{kind=push_payload_frame|push_decode_copy_pb|...}"),
     "elasticdl_ps_push_handle_seconds": _H(
         "push_gradients handle time"),
     "elasticdl_ps_pull_dense_seconds": _H(

@@ -412,6 +412,9 @@ def ps_to_prometheus(status):
     ] + [
         prometheus_line("elasticdl_ps_requests", count, kind=kind)
         for kind, count in sorted(status["counters"].items())
+    ] + [
+        prometheus_line("elasticdl_ps_wire_bytes", count, kind=kind)
+        for kind, count in sorted(status.get("wire", {}).items())
     ]
     for phase, metric in (
             ("ps.push_handle", "elasticdl_ps_push_handle_seconds"),

@@ -444,6 +444,33 @@ def _read_exact(fp, n, what):
     return b"".join(chunks)
 
 
+def peek_frame_header(data):
+    """Parse ONLY the preamble + JSON header of an in-memory frame —
+    no tensor views, no payload touch.  This is the PS servicer's
+    fencing read (docs/ps_recovery.md): ``generation`` rides in the
+    header's meta, so a push stamped by a dead incarnation is rejected
+    BEFORE any payload decode.  The preamble's claimed total is
+    cross-checked against ``len(data)`` exactly as in
+    :func:`decode_frame`, so a lying length is loud here, not later."""
+    buf = memoryview(data)
+    header_len, payload_len = _unpack_preamble(buf)
+    total = FRAME_PREAMBLE_SIZE + header_len + payload_len
+    if len(buf) != total:
+        raise FrameError(
+            "frame length %d does not match the preamble's %d "
+            "(truncated or trailing garbage)" % (len(buf), total))
+    return _parse_header(
+        bytes(buf[FRAME_PREAMBLE_SIZE:FRAME_PREAMBLE_SIZE
+                  + header_len]))
+
+
+def frame_meta(header):
+    """The header's meta dict ({} when absent or not an object) — the
+    same coercion :func:`decode_frame` applies."""
+    meta = header.get("meta")
+    return meta if isinstance(meta, dict) else {}
+
+
 def read_frame_header(fp, limit=None):
     """Read EXACTLY the preamble + header from a stream and stop —
     the router's keyed-placement read: the routing decision needs the
@@ -554,3 +581,173 @@ def decode_model_frame(data):
                          "%s" % (sorted(ids), sorted(vals)))
     embeddings = {t: (ids[t], vals[t]) for t in ids}
     return dense, embeddings, frame.model_version
+
+
+# -- PS data-plane frames (docs/ps_pipeline.md "Frame wire") ---------------
+#
+# The gradient push / dense pull twins of the pb ModelPB path: one frame
+# blob per RPC, riding the RawFrame identity codec (proto/rpc.py) so the
+# servicer's decode_frame views alias the gRPC message bytes directly.
+# The frame header's meta carries what the proto envelope used to —
+# generation (so fencing rejects before decode) and the lr override.
+# Embedding pairs here use the PS push ordering (values, ids), unlike
+# the export-side model frames' (ids, values).
+
+GRADS_FRAME_KIND = "grads"
+PARAMS_FRAME_KIND = "params"
+
+
+def encode_grads_frame(dense=None, embeddings=None, version=0,
+                       learning_rate=0.0, generation=0,
+                       wire_dtype=None):
+    """One shard's gradient push ({name: array} dense + {table:
+    (values, ids)} embeddings) as a single frame.  ``wire_dtype``
+    compresses float32 content exactly as ``model_to_pb`` does (ids
+    always stay int64)."""
+    tensors = []
+    for name, arr in (dense or {}).items():
+        tensors.append((_DENSE_PREFIX + name, arr))
+    for table, (values, ids) in (embeddings or {}).items():
+        tensors.append((_EMB_VALS_PREFIX + table, values))
+        tensors.append((_EMB_IDS_PREFIX + table,
+                        np.asarray(ids, np.int64)))
+    meta = {"generation": int(generation),
+            "learning_rate": float(learning_rate)}
+    return encode_frame(tensors, kind=GRADS_FRAME_KIND,
+                        model_version=version, wire_dtype=wire_dtype,
+                        meta=meta)
+
+
+def decode_grads_frame(data):
+    """-> (dense, {table: (values, ids)}, version, learning_rate).
+    Zero-copy views over ``data`` (upcast-copy only for reduced-
+    precision wire dtypes); refuses any other frame kind."""
+    frame = decode_frame(data)
+    if frame.kind != GRADS_FRAME_KIND:
+        raise FrameError("not a gradient frame (kind %r)" % frame.kind)
+    dense = {}
+    ids = {}
+    vals = {}
+    for name, arr in frame.tensors.items():
+        if name.startswith(_DENSE_PREFIX):
+            dense[name[len(_DENSE_PREFIX):]] = arr
+        elif name.startswith(_EMB_IDS_PREFIX):
+            table = name[len(_EMB_IDS_PREFIX):]
+            if arr.dtype != np.int64 or arr.ndim != 1:
+                raise FrameError(
+                    "embedding id tensor %r must be int64 [n], got %s "
+                    "%r" % (name, arr.dtype.name, arr.shape))
+            ids[table] = arr
+        elif name.startswith(_EMB_VALS_PREFIX):
+            vals[name[len(_EMB_VALS_PREFIX):]] = arr
+        else:
+            raise FrameError("gradient frame tensor %r has no d/ei/ev "
+                             "prefix" % name)
+    if set(ids) != set(vals):
+        raise FrameError("embedding ids/values tables mismatch: %s vs "
+                         "%s" % (sorted(ids), sorted(vals)))
+    for table in ids:
+        if vals[table].shape[:1] != ids[table].shape:
+            raise FrameError(
+                "embedding table %r: %d value rows for %d ids"
+                % (table, vals[table].shape[0] if vals[table].ndim
+                   else 0, ids[table].size))
+    embeddings = {t: (vals[t], ids[t]) for t in ids}
+    try:
+        learning_rate = float(
+            frame.meta.get("learning_rate", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        raise FrameError("meta learning_rate %r is not a number"
+                         % (frame.meta.get("learning_rate"),))
+    return dense, embeddings, frame.model_version, learning_rate
+
+
+def encode_params_frame(dense=None, version=0, initialized=True,
+                        generation=0, wire_dtype=None):
+    """A dense-parameter pull response as a single frame.  The
+    not-modified fast path is simply a frame with no tensors — the
+    header (initialized/version/generation in meta) still rides, so
+    generation tracking works exactly as on the pb path."""
+    tensors = [(_DENSE_PREFIX + name, arr)
+               for name, arr in (dense or {}).items()]
+    meta = {"initialized": bool(initialized),
+            "generation": int(generation)}
+    return encode_frame(tensors, kind=PARAMS_FRAME_KIND,
+                        model_version=version, wire_dtype=wire_dtype,
+                        meta=meta)
+
+
+def decode_params_frame(data):
+    """-> (initialized, version, generation, {name: array})."""
+    frame = decode_frame(data)
+    if frame.kind != PARAMS_FRAME_KIND:
+        raise FrameError("not a params frame (kind %r)" % frame.kind)
+    dense = {}
+    for name, arr in frame.tensors.items():
+        if not name.startswith(_DENSE_PREFIX):
+            raise FrameError("params frame tensor %r has no d/ prefix"
+                             % name)
+        dense[name[len(_DENSE_PREFIX):]] = arr
+    try:
+        generation = int(frame.meta.get("generation", 0) or 0)
+    except (TypeError, ValueError):
+        raise FrameError("meta generation %r is not an integer"
+                         % (frame.meta.get("generation"),))
+    return (bool(frame.meta.get("initialized")), frame.model_version,
+            generation, dense)
+
+
+# -- decode-copy accounting ------------------------------------------------
+#
+# "Decode-copy bytes" = bytes the CODEC layer copies to turn a received
+# message into consumable ndarrays (transport-level costs are identical
+# across encodings and excluded).  Computed structurally from shapes so
+# the accounting itself never forces an extra materialization.
+#
+#  - pb: every TensorPB.content access materializes a fresh Python
+#    bytes object (one full payload copy), each repeated-int64 id is
+#    boxed into a Python int on conversion (8 bytes/id counted, the
+#    boxing overhead is free on top), and a reduced-precision
+#    wire_dtype pays the upcast allocation.
+#  - frame: tensor views alias the wire buffer — only the wire_dtype
+#    upcast allocates.  Both paths count the upcast, so the bench's
+#    frame-vs-pb ratio at equal wire_dtype is honest.
+
+def pb_decode_copy_bytes(t):
+    """Copy bytes :func:`pb_to_ndarray` pays for one TensorPB."""
+    count = 1
+    for d in t.dims:
+        count *= d
+    wire = t.wire_dtype or t.dtype
+    total = count * _np_dtype(wire).itemsize
+    if t.wire_dtype and t.wire_dtype != t.dtype:
+        total += count * _np_dtype(t.dtype).itemsize
+    return total
+
+
+def model_pb_decode_copy_bytes(m):
+    """Copy bytes :func:`pb_to_model` pays for one ModelPB."""
+    total = 0
+    for t in m.dense_parameters.values():
+        total += pb_decode_copy_bytes(t)
+    for s in m.embedding_tables.values():
+        total += pb_decode_copy_bytes(s.values) + 8 * len(s.ids)
+    return total
+
+
+def frame_decode_copy_bytes(header):
+    """Copy bytes :func:`decode_frame` pays, from a (peeked) header:
+    zero per aligned view, the upcast allocation when a tensor rides a
+    reduced-precision wire_dtype."""
+    total = 0
+    for entry in header.get("tensors", ()):
+        if not isinstance(entry, dict):
+            continue
+        wire = entry.get("wire_dtype")
+        if not wire or wire == entry.get("dtype"):
+            continue
+        count = 1
+        for d in entry.get("shape", ()):
+            count *= int(d)
+        total += count * _np_dtype(entry["dtype"]).itemsize
+    return total

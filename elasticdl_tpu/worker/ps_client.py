@@ -36,6 +36,7 @@ import time
 import uuid
 from collections import deque
 
+import grpc
 import numpy as np
 
 from elasticdl_tpu.proto import elastic_pb2 as pb
@@ -47,7 +48,8 @@ logger = get_logger(__name__)
 
 
 def build_ps_client(ps_addrs, wire_dtype=None,
-                    dedicated_push_channels=False, retry=None):
+                    dedicated_push_channels=False, retry=None,
+                    frame_wire="auto"):
     """ps_addrs: comma-separated or list of host:port.
 
     ``dedicated_push_channels`` opens a second connection per shard for
@@ -58,7 +60,12 @@ def build_ps_client(ps_addrs, wire_dtype=None,
     ``retry``: a utils.retry.RetryPolicy (e.g. ``ps_rpc_policy()``)
     arming per-shard outage riding with channel rebuild; None keeps the
     historical fail-fast behavior (the worker-level minibatch retry is
-    then the only ride-out)."""
+    then the only ride-out).
+
+    ``frame_wire``: "auto" (default) negotiates the raw-frame data
+    plane per shard from the ``frame_capable`` bit on legacy pull
+    responses; "on" forces it (benches/tests); "off" stays on the
+    TensorPB encoding everywhere."""
     if isinstance(ps_addrs, str):
         ps_addrs = [a for a in ps_addrs.split(",") if a]
 
@@ -73,7 +80,7 @@ def build_ps_client(ps_addrs, wire_dtype=None,
     return PSClient(
         connect(), wire_dtype=wire_dtype,
         push_channels=connect() if dedicated_push_channels else None,
-        addrs=list(ps_addrs), retry=retry,
+        addrs=list(ps_addrs), retry=retry, frame_wire=frame_wire,
     )
 
 
@@ -90,7 +97,7 @@ class PSClient:
     _REBUILD_INTERVAL_SECS = 2.0
 
     def __init__(self, channels, wire_dtype=None, push_channels=None,
-                 addrs=None, retry=None):
+                 addrs=None, retry=None, frame_wire="auto"):
         if push_channels is not None and len(push_channels) != len(channels):
             raise ValueError(
                 "push_channels must match channels per shard (%d != %d)"
@@ -144,6 +151,26 @@ class PSClient:
                 % (wire_dtype, ", ".join(tensor_codec.WIRE_DTYPES))
             )
         self.wire_dtype = wire_dtype
+        # Raw-frame data plane (docs/ps_pipeline.md "Frame wire"):
+        # "auto" starts every shard on TensorPB and upgrades it when a
+        # legacy pull response advertises frame_capable; "on" forces
+        # frames from the first RPC; "off" never leaves TensorPB.  A
+        # frame RPC answered UNIMPLEMENTED (rolling upgrade against an
+        # older shard) downgrades that shard back to the legacy
+        # encoding.  Per-shard plain bools: flips are idempotent and
+        # GIL-atomic, so no lock.
+        if frame_wire not in ("auto", "on", "off"):
+            raise ValueError(
+                "frame_wire must be 'auto', 'on' or 'off', got %r"
+                % (frame_wire,)
+            )
+        self._frame_wire = frame_wire
+        self._frame_ok = [frame_wire == "on"] * self.num_ps
+        # Generation at which a shard refused a frame RPC: its
+        # frame_capable advert is ignored until the shard restarts
+        # (new generation = possibly a new binary), so a lying advert
+        # can't ping-pong upgrade/UNIMPLEMENTED on every RPC.
+        self._frame_refused_gen = [None] * self.num_ps
         # table name -> row dim, learned from the embedding infos this
         # client pushes; lets empty pulls keep their (0, dim) shape.
         self._emb_dims = {}
@@ -155,14 +182,24 @@ class PSClient:
         self._gen_lock = threading.Lock()
         self._shard_generations = [0] * self.num_ps
         self.generation_epoch = 0
-        # Serialized payload bytes per direction.  Bumped from the step
-        # thread, the push executor, AND the prefetch pool concurrently,
-        # so every += runs under the stats lock (these are the bench's
+        # Serialized payload bytes per direction AND per wire encoding
+        # (frame vs pb), plus the decode-copy bytes the receiving codec
+        # pays for each encoding (tensor_codec decode-copy accounting —
+        # computed structurally from the very messages this client
+        # builds/decodes).  Bumped from the step thread, the push
+        # executor, AND the prefetch pool concurrently, so every +=
+        # runs under the stats lock (these are the bench's
         # bytes-on-wire artifact — lost updates would skew it).
         self._stats_lock = threading.Lock()
         self.wire_stats = {
-            "push_gradient_bytes": 0,
-            "pull_dense_bytes": 0,
+            "push_gradient_bytes_pb": 0,
+            "push_gradient_bytes_frame": 0,
+            "push_decode_copy_bytes_pb": 0,
+            "push_decode_copy_bytes_frame": 0,
+            "pull_dense_bytes_pb": 0,
+            "pull_dense_bytes_frame": 0,
+            "pull_dense_decode_copy_bytes_pb": 0,
+            "pull_dense_decode_copy_bytes_frame": 0,
             "pull_embedding_bytes": 0,
         }
 
@@ -203,6 +240,52 @@ class PSClient:
                 "PS shard %d restarted: generation %d -> %d "
                 "(reconcile pending)", shard, old, generation,
             )
+
+    # -- frame-wire negotiation ----------------------------------------------
+
+    def frame_shards(self):
+        """Shards currently speaking the raw-frame data plane (for the
+        bench/tests and the status surface)."""
+        return sum(1 for ok in self._frame_ok if ok)
+
+    def _maybe_upgrade(self, shard, res):
+        """A legacy pull response advertising ``frame_capable``
+        upgrades this shard's subsequent push/pull traffic to the
+        frame RPCs (auto mode only).  An advert from the SAME
+        incarnation that already refused a frame RPC is ignored —
+        without that memory a server that advertises but doesn't
+        implement (version-skewed rollout) would ping-pong every
+        request through an UNIMPLEMENTED probe."""
+        if res.generation != self._frame_refused_gen[shard]:
+            self._frame_refused_gen[shard] = None
+        if (self._frame_wire == "auto" and res.frame_capable
+                and self._frame_refused_gen[shard] is None
+                and not self._frame_ok[shard]):
+            self._frame_ok[shard] = True
+            logger.info(
+                "PS shard %d advertises the frame wire; upgrading "
+                "push/pull traffic to frame RPCs", shard,
+            )
+
+    def _frame_downgrade(self, shard, err):
+        """UNIMPLEMENTED from a frame RPC means the shard predates the
+        frame plane (rolling upgrade): drop this shard back to the
+        legacy TensorPB encoding and tell the caller to re-issue.
+        Anything else — including UNIMPLEMENTED under forced "on"
+        mode — is a real failure the caller must surface."""
+        code = err.code() if hasattr(err, "code") else None
+        if (code != grpc.StatusCode.UNIMPLEMENTED
+                or self._frame_wire == "on"):
+            return False
+        if self._frame_ok[shard]:
+            self._frame_ok[shard] = False
+            self._frame_refused_gen[shard] = self.known_generation(
+                shard)
+            logger.warning(
+                "PS shard %d does not implement the frame wire; "
+                "falling back to TensorPB", shard,
+            )
+        return True
 
     # -- outage riding -------------------------------------------------------
 
@@ -331,27 +414,75 @@ class PSClient:
         Each shard's request carries the generation this client last
         observed for it: a restarted shard answers with the full dense
         state even when its restored version is BELOW ours (the fast
-        path comparison points the wrong way after a rollback)."""
+        path comparison points the wrong way after a rollback).
+
+        A frame-upgraded shard is pulled over the raw-frame RPC (one
+        blob, zero-copy decode); everyone else rides the legacy
+        TensorPB response, whose ``frame_capable`` bit is how "auto"
+        mode learns to upgrade the shard for NEXT time."""
         pending = []
         for shard in range(self.num_ps):
             req = pb.PullDenseParametersRequest(
                 version=version,
                 generation=self.known_generation(shard),
             )
+            framed = self._frame_ok[shard]
             with self._refresh_lock:
                 stub = self._stubs[shard]
                 state = {"gen": self._conn_gens[shard]}
-            pending.append((shard, req, stub.pull_dense_parameters,
-                            stub.pull_dense_parameters.future(req),
-                            state))
+            if framed:
+                rpc_fn = stub.pull_dense_parameters_frame
+                future = stub.pull_dense_parameters_frame.future(req)
+            else:
+                rpc_fn = stub.pull_dense_parameters
+                future = stub.pull_dense_parameters.future(req)
+            pending.append((shard, framed, req, rpc_fn, future, state))
         dense = {}
         initialized = True
         server_version = 0
-        for shard, req, rpc_fn, future, state in pending:
+        for shard, framed, req, rpc_fn, future, state in pending:
+            if framed:
+                try:
+                    blob = self._result(
+                        shard, "pull_dense_parameters_frame", rpc_fn,
+                        req, future, state,
+                    )
+                except Exception as err:  # noqa: BLE001 — classified
+                    if not self._frame_downgrade(shard, err):
+                        raise
+                    # Rolling downgrade: re-issue the SAME request on
+                    # the legacy RPC with a fresh stub snapshot.
+                    with self._refresh_lock:
+                        stub = self._stubs[shard]
+                        state = {"gen": self._conn_gens[shard]}
+                    rpc_fn = stub.pull_dense_parameters
+                    future = stub.pull_dense_parameters.future(req)
+                else:
+                    header = tensor_codec.peek_frame_header(blob)
+                    (shard_init, shard_version, generation,
+                     shard_dense) = tensor_codec.decode_params_frame(
+                        blob)
+                    self._note_generation(shard, generation)
+                    self._count_bytes("pull_dense_bytes_frame",
+                                      len(blob))
+                    self._count_bytes(
+                        "pull_dense_decode_copy_bytes_frame",
+                        tensor_codec.frame_decode_copy_bytes(header),
+                    )
+                    initialized = initialized and shard_init
+                    server_version = max(server_version, shard_version)
+                    dense.update(shard_dense)
+                    continue
             res = self._result(shard, "pull_dense_parameters", rpc_fn,
                                req, future, state)
             self._note_generation(shard, res.generation)
-            self._count_bytes("pull_dense_bytes", res.ByteSize())
+            self._maybe_upgrade(shard, res)
+            self._count_bytes("pull_dense_bytes_pb", res.ByteSize())
+            self._count_bytes(
+                "pull_dense_decode_copy_bytes_pb",
+                sum(tensor_codec.pb_decode_copy_bytes(t)
+                    for t in res.dense_parameters.values()),
+            )
             initialized = initialized and res.initialized
             server_version = max(server_version, res.version)
             for name, t in res.dense_parameters.items():
@@ -443,30 +574,103 @@ class PSClient:
         for shard in range(self.num_ps):
             if not shard_dense[shard] and not shard_emb[shard]:
                 continue
-            model = tensor_codec.model_to_pb(
-                dense=shard_dense[shard],
-                embeddings=shard_emb[shard],
-                version=version,
-                wire_dtype=self.wire_dtype,
+            generation = (
+                generations[shard] if generations is not None
+                else self.known_generation(shard)
             )
-            req = pb.PushGradientsRequest(
-                gradients=model, learning_rate=learning_rate,
-                generation=(
-                    generations[shard] if generations is not None
-                    else self.known_generation(shard)
-                ),
-            )
-            self._count_bytes("push_gradient_bytes", req.ByteSize())
+            framed = self._frame_ok[shard]
+            if framed:
+                # One frame blob IS the gRPC message (RawFrame identity
+                # codec): generation and lr ride in the frame header's
+                # meta so the servicer fences before decoding.
+                blob = tensor_codec.encode_grads_frame(
+                    dense=shard_dense[shard],
+                    embeddings=shard_emb[shard],
+                    version=version,
+                    learning_rate=learning_rate,
+                    generation=generation,
+                    wire_dtype=self.wire_dtype,
+                )
+                self._count_bytes("push_gradient_bytes_frame",
+                                  len(blob))
+                self._count_bytes(
+                    "push_decode_copy_bytes_frame",
+                    tensor_codec.frame_decode_copy_bytes(
+                        tensor_codec.peek_frame_header(blob)),
+                )
+            else:
+                model = tensor_codec.model_to_pb(
+                    dense=shard_dense[shard],
+                    embeddings=shard_emb[shard],
+                    version=version,
+                    wire_dtype=self.wire_dtype,
+                )
+                req = pb.PushGradientsRequest(
+                    gradients=model, learning_rate=learning_rate,
+                    generation=generation,
+                )
+                self._count_bytes("push_gradient_bytes_pb",
+                                  req.ByteSize())
+                self._count_bytes(
+                    "push_decode_copy_bytes_pb",
+                    tensor_codec.model_pb_decode_copy_bytes(model),
+                )
             with self._refresh_lock:
                 stub = self._push_stubs[shard]
                 state = {"gen": self._conn_gens[shard]}
-            pending.append((shard, req, stub.push_gradients,
-                            stub.push_gradients.future(req), state))
+            if framed:
+                req = blob
+                rpc_fn = stub.push_gradients_frame
+                future = stub.push_gradients_frame.future(blob)
+            else:
+                rpc_fn = stub.push_gradients
+                future = stub.push_gradients.future(req)
+            pending.append((shard, framed, generation, req, rpc_fn,
+                            future, state))
         accepted = True
         max_version = 0
-        for shard, req, rpc_fn, future, state in pending:
-            res = self._result(shard, "push_gradients", rpc_fn, req,
-                               future, state, push=True)
+        for (shard, framed, generation, req, rpc_fn, future,
+             state) in pending:
+            if framed:
+                try:
+                    res = self._result(shard, "push_gradients_frame",
+                                       rpc_fn, req, future, state,
+                                       push=True)
+                except Exception as err:  # noqa: BLE001 — classified
+                    if not self._frame_downgrade(shard, err):
+                        raise
+                    # Rebuild the legacy request from the still-held
+                    # shard buckets, stamped with the SAME generation
+                    # the frame carried — re-stamping with a fresher
+                    # one would unfence a pre-restart gradient.
+                    model = tensor_codec.model_to_pb(
+                        dense=shard_dense[shard],
+                        embeddings=shard_emb[shard],
+                        version=version,
+                        wire_dtype=self.wire_dtype,
+                    )
+                    legacy = pb.PushGradientsRequest(
+                        gradients=model,
+                        learning_rate=learning_rate,
+                        generation=generation,
+                    )
+                    self._count_bytes("push_gradient_bytes_pb",
+                                      legacy.ByteSize())
+                    self._count_bytes(
+                        "push_decode_copy_bytes_pb",
+                        tensor_codec.model_pb_decode_copy_bytes(model),
+                    )
+                    with self._refresh_lock:
+                        stub = self._push_stubs[shard]
+                        state = {"gen": self._conn_gens[shard]}
+                    res = self._result(
+                        shard, "push_gradients", stub.push_gradients,
+                        legacy, stub.push_gradients.future(legacy),
+                        state, push=True,
+                    )
+            else:
+                res = self._result(shard, "push_gradients", rpc_fn,
+                                   req, future, state, push=True)
             self._note_generation(shard, res.generation)
             accepted = accepted and res.accepted
             max_version = max(max_version, res.version)
@@ -517,7 +721,9 @@ class PSClient:
                 learning_rate=learning_rate,
                 generation=self.known_generation(shard),
             )
-            self._count_bytes("push_gradient_bytes", req.ByteSize())
+            # 2PC stays on the TensorPB wire (docs/ps_pipeline.md
+            # "Frame wire" fallback matrix).
+            self._count_bytes("push_gradient_bytes_pb", req.ByteSize())
             with self._refresh_lock:
                 stub = self._stubs[shard]
                 state = {"gen": self._conn_gens[shard]}

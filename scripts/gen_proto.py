@@ -123,6 +123,14 @@ EDITS = [
     # both derive from it.
     ("ReportBatchDoneRequest", "hist_delta", 9, F.TYPE_STRING,
      "histDelta"),
+    # Frame-wire negotiation (docs/ps_pipeline.md "Frame wire"): a PS
+    # shard advertises the raw-frame data plane on every legacy dense
+    # pull response; a capable client upgrades that shard's push/pull
+    # traffic to the push_gradients_frame / pull_dense_parameters_frame
+    # methods (one zero-copy frame blob per RPC instead of repeated
+    # TensorPB), falling back per shard on UNIMPLEMENTED.
+    ("PullDenseParametersResponse", "frame_capable", 5, F.TYPE_BOOL,
+     "frameCapable"),
 ]
 
 

@@ -18,6 +18,10 @@ Runs, in order, each in a fresh subprocess with the CPU platform pinned:
      (e2e ratio within 25% of the endpoint-layer ratio, binary p99
      within 10% of JSON's, JSON-vs-binary bit-identity, router
      byte-identical pass-through)
+  8. bench_ps_wire.py --frame_only: the frame-native PS data plane's
+     gates (decode-copy bytes >= 1.3x smaller than TensorPB at equal
+     wire dtype, loopback steps/s >= 1.0x, same-seed serialized
+     losses bit-identical frame-vs-pb)
 
 Exits nonzero on the FIRST failure with the failing stage named.  Run it
 before every end-of-round snapshot — round 2 shipped a broken HEAD
@@ -182,6 +186,31 @@ def main(argv=None):
               % (parsed.get("value"), parsed.get("vs_baseline"),
                  detail.get("p99_ms_binary_server_side"),
                  detail.get("p99_ms_json_server_side")))
+
+        # Frame-native PS data plane (ISSUE 17): frame-vs-TensorPB at
+        # equal wire dtype — decode-copy bytes >= 1.3x smaller,
+        # loopback steps/s >= 1.0x, and same-seed serialized losses
+        # bit-identical.  bench_ps_wire --frame_only exits nonzero
+        # itself when any gate fails.
+        ok, out = run_stage(
+            "bench_ps_wire.py --frame_only (frame-wire gates)",
+            [sys.executable, "bench_ps_wire.py", "--frame_only"],
+            timeout=900,
+        )
+        if not ok:
+            return 1
+        parsed = last_json_line(out)
+        gates = (parsed or {}).get("gates", {})
+        if not (parsed or {}).get("pass"):
+            print("[preflight] FAIL bench_ps_wire --frame_only: "
+                  "gates %s" % gates)
+            return 1
+        detail = (parsed or {}).get("detail", {})
+        print("[preflight] frame wire: decode-copy %sx, loopback "
+              "steps %sx, bit-identical %s"
+              % (parsed.get("value"),
+                 detail.get("steps_ratio_frame_over_pb_loopback"),
+                 gates.get("losses_bit_identical")))
 
     print("[preflight] ALL GREEN")
     return 0

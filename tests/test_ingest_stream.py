@@ -1,0 +1,190 @@
+"""Cross-host streamed ingest (PR 17): the aggregator's POST /ingest
+endpoint + the frame client SDK + the exporter's ``stream_to`` hook.
+
+This is the real three-host topology under test: trainer and
+aggregator share NO filesystem — versions arrive only as
+``model.frame`` blobs over HTTP.  The status contract is load-bearing
+(the exporter's recovery differs per cause): 400 malformed, 409
+stale, 415 wrong content type, 422 program missing; and the endpoint
+must survive every rejection on a keep-alive connection."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.aggregation import ModelAggregator
+from elasticdl_tpu.aggregation.main import IngestServer
+from elasticdl_tpu.client.frame_client import (
+    FrameClient,
+    FrameClientError,
+    ProgramRequiredError,
+    StaleVersionError,
+)
+from elasticdl_tpu.serving.export import ContinuousExporter
+from elasticdl_tpu.serving.loader import load_servable
+from elasticdl_tpu.utils import tensor_codec
+from elasticdl_tpu.utils.tensor_codec import FrameError
+
+
+def _apply(p, x):
+    return x @ p["w"]
+
+
+def _exporter(base):
+    return ContinuousExporter(str(base), model_name="lin",
+                              platforms=("cpu",))
+
+
+def _frame(ce, version, value, **kw):
+    return ce.frame_bytes(
+        version, _apply,
+        {"w": np.full((4, 2), value, np.float32)},
+        np.zeros((1, 4), np.float32), **kw)
+
+
+@pytest.fixture
+def rig(tmp_path):
+    # Disjoint directories: the aggregator's scan source is never
+    # written; everything arrives over the wire.
+    agg = ModelAggregator(str(tmp_path / "agg_src"),
+                          str(tmp_path / "pub"),
+                          window=2, mode="latest")
+    server = IngestServer(agg, port=0, host="127.0.0.1")
+    server.start()
+    client = FrameClient("127.0.0.1:%d" % server.port, timeout=30)
+    ce = _exporter(tmp_path / "trainer_side")
+    try:
+        yield agg, server, client, ce, tmp_path / "pub"
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_ingest_roundtrip_and_status_contract(rig):
+    agg, server, client, ce, pub = rig
+    assert client.ingest(_frame(ce, 1, 1.0)) == 1
+    assert client.ingest(_frame(ce, 2, 2.0)) == 2
+    # 409: stale version, surfaced as the typed skip signal
+    with pytest.raises(StaleVersionError) as err:
+        client.ingest(_frame(ce, 1, 9.0))
+    assert err.value.status == 409
+    # 400: a malformed blob is the SAME exception a local decode
+    # raises
+    with pytest.raises(FrameError):
+        client.ingest(b"\xff" * 64)
+    # 415: wrong content type (this endpoint speaks only frames)
+    status, _, _ = client.roundtrip("/ingest", b"{}",
+                                    content_type="application/json")
+    assert status == 415
+    # 404: unknown path
+    status, _, _ = client.roundtrip("/nope", b"")
+    assert status == 404
+    # the aggregator state is what the wire said
+    version, _ = agg.publish()
+    assert version == 2
+    model = load_servable(str(pub / "2"))
+    out = np.asarray(model.predict(np.ones((1, 4), np.float32)))
+    assert out[0, 0] == pytest.approx(8.0)
+    counters = agg.stats()["counters"]
+    assert counters["ingested_frames"] == 2
+    assert counters["stale_exports_skipped"] == 1
+    assert counters["ingest_frame_rejected"] == 1
+
+
+def test_hostile_blobs_then_keep_alive_survives(rig):
+    _, _, client, ce, _ = rig
+    good = _frame(ce, 1, 1.0)
+    hostiles = [
+        good[: len(good) - 7],                     # truncated
+        b"NOPE" + good[4:],                        # foreign magic
+        good[:4] + (2 ** 31).to_bytes(4, "little") + good[8:],
+        tensor_codec.encode_frame(                 # wrong kind
+            {"x": np.zeros(1, np.float32)}, kind="predict"),
+    ]
+    for blob in hostiles:
+        with pytest.raises(FrameError):
+            client.ingest(blob)
+    # same client, pooled connections: a good push still lands
+    assert client.ingest(good) == 1
+
+
+def test_422_when_aggregator_lost_its_program_cache(rig):
+    agg, server, client, ce, _ = rig
+    assert client.ingest(_frame(ce, 1, 1.0)) == 1
+    # weights-only frame for a NEW tree: this aggregator has never
+    # seen its program
+    blob = ce.frame_bytes(
+        2, lambda p, x: x @ p["w2"],
+        {"w2": np.full((4, 3), 1.0, np.float32)},
+        np.zeros((1, 4), np.float32), include_program=False)
+    with pytest.raises(ProgramRequiredError) as err:
+        client.ingest(blob)
+    assert err.value.status == 422
+    assert agg.stats()["counters"]["program_missing_rejected"] == 1
+    # nothing was partially applied: the window still publishes v1
+    assert agg.publish()[0] == 1
+
+
+def test_stream_to_re_primes_after_aggregator_restart(rig, tmp_path):
+    agg, server, client, ce, pub = rig
+    params = {"w": np.full((4, 2), 1.0, np.float32)}
+    x = np.zeros((1, 4), np.float32)
+    assert ce.stream_to(client, 1, _apply, params, x) == 1
+    assert ce.stream_to(client, 2, _apply, params, x) == 2
+    # stale re-send: swallowed as a skip, not an error
+    assert ce.stream_to(client, 1, _apply, params, x) is None
+    assert ce.stream_stats == {"pushed": 2, "stale": 1, "reprimed": 0}
+    # Mid-stream aggregator restart: a FRESH aggregator (empty program
+    # cache) behind a new endpoint.  The exporter's steady-state
+    # weights-only push must trigger the 422 -> include_program=True
+    # re-prime WITHOUT trainer intervention.
+    server.stop()
+    agg2 = ModelAggregator(str(tmp_path / "agg2_src"),
+                           str(tmp_path / "pub2"),
+                           window=2, mode="latest")
+    server2 = IngestServer(agg2, port=0, host="127.0.0.1")
+    server2.start()
+    client2 = FrameClient("127.0.0.1:%d" % server2.port)
+    try:
+        assert ce.stream_to(client2, 3, _apply, params, x) == 3
+        assert ce.stream_stats["reprimed"] == 1
+        assert ce.stream_stats["pushed"] == 3
+        version, _ = agg2.publish()
+        assert version == 3
+        model = load_servable(str(tmp_path / "pub2" / "3"))
+        out = np.asarray(model.predict(np.ones((1, 4), np.float32)))
+        assert out.shape == (1, 2)
+    finally:
+        client2.close()
+        server2.stop()
+
+
+def test_cross_host_drill_freshness_slo_green(rig):
+    """The acceptance drill: trainer and aggregator in disjoint
+    directories, versions arriving ONLY through the streamed endpoint,
+    and the freshness SLO (publish wall - export birth) green."""
+    agg, server, client, ce, pub = rig
+    params = {"w": np.full((4, 2), 3.0, np.float32)}
+    x = np.zeros((1, 4), np.float32)
+    for v in (1, 2):
+        assert ce.stream_to(client, v, _apply, params, x) == v
+    version, _ = agg.publish()
+    assert version == 2
+    stats = agg.stats()
+    assert stats["freshness_seconds"] is not None
+    assert stats["freshness_seconds"] < stats["freshness_slo_secs"]
+    # the aggregator's scan source stayed empty the whole time: no
+    # filesystem was shared
+    assert agg.stats()["counters"].get("ingested", 0) == 2
+    assert stats["counters"]["ingested_frames"] == 2
+
+
+def test_error_mapping_unknown_status():
+    err = FrameClient._error(503, b'{"error": "draining"}')
+    assert isinstance(err, FrameClientError)
+    assert err.status == 503 and "draining" in err.message
+    assert isinstance(FrameClient._error(400, b'{"error": "x"}'),
+                      FrameError)
+    assert isinstance(FrameClient._error(409, b"{}"),
+                      StaleVersionError)
+    assert isinstance(FrameClient._error(422, b"not json"),
+                      ProgramRequiredError)

@@ -1,0 +1,382 @@
+"""Frame-native PS data plane over real in-process gRPC (PR 17).
+
+What the pb wire tests in test_pserver.py prove for TensorPB, this
+file proves for the raw-frame RPCs: negotiation (auto-upgrade on the
+``frame_capable`` bit, rolling downgrade on UNIMPLEMENTED), apply
+bit-identity frame-vs-pb at the same seed, generation fencing read
+from the frame HEADER (rejected before any payload decode), and the
+hostile-blob contract — every malformed frame class must come back a
+loud INVALID_ARGUMENT with the servicer intact on the same
+connection."""
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.proto import rpc
+from elasticdl_tpu.proto import elastic_pb2 as pb
+from elasticdl_tpu.ps.optimizer import create_optimizer
+from elasticdl_tpu.ps.parameters import Parameters
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.utils import grpc_utils, tensor_codec
+from elasticdl_tpu.worker.ps_client import PSClient
+
+
+def start_ps(num_ps=1, opt_type="sgd", opt_args="learning_rate=0.1",
+             frame_wire="auto", legacy_wire=False, **kwargs):
+    """Boot N in-process PS shards; returns (client, servicers,
+    servers).  ``legacy_wire=True`` registers every method EXCEPT the
+    frame RPCs — the pre-frame server binary a rolling downgrade must
+    survive (its legacy pull still advertises ``frame_capable``, which
+    is exactly the trap: the client upgrades, then hits
+    UNIMPLEMENTED)."""
+    servers, servicers, channels = [], [], []
+    for i in range(num_ps):
+        servicer = PserverServicer(
+            Parameters(), create_optimizer(opt_type, opt_args),
+            ps_id=i, num_ps=num_ps, **kwargs,
+        )
+        server = grpc_utils.build_server(max_workers=8)
+        if legacy_wire:
+            handlers = {}
+            for name, (req_cls, res_cls) in rpc.SERVICES[
+                    "elasticdl_tpu.PServer"].items():
+                if name.endswith("_frame"):
+                    continue
+                handlers[name] = grpc.unary_unary_rpc_method_handler(
+                    getattr(servicer, name),
+                    request_deserializer=req_cls.FromString,
+                    response_serializer=res_cls.SerializeToString,
+                )
+            server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    "elasticdl_tpu.PServer", handlers),
+            ))
+        else:
+            rpc.add_pserver_servicer(servicer, server)
+        port = server.add_insecure_port("[::]:0")
+        server.start()
+        channel = grpc_utils.build_channel("localhost:%d" % port)
+        grpc_utils.wait_for_channel_ready(channel)
+        servers.append(server)
+        servicers.append(servicer)
+        channels.append(channel)
+    return (PSClient(channels, frame_wire=frame_wire), servicers,
+            servers)
+
+
+def stop_all(servers):
+    for s in servers:
+        s.stop(grace=None)
+
+
+def _dense(seed=0, n=3):
+    rng = np.random.RandomState(seed)
+    return {"layer%d/w" % i: rng.rand(4).astype(np.float32)
+            for i in range(n)}
+
+
+# -- negotiation ----------------------------------------------------------
+
+
+def test_auto_upgrades_after_first_legacy_pull():
+    client, _, servers = start_ps(num_ps=2, frame_wire="auto")
+    try:
+        assert client.frame_shards() == 0
+        client.push_model(_dense())
+        client.pull_dense_parameters(-1)  # legacy; reads frame_capable
+        assert client.frame_shards() == 2
+        # and the upgraded wire round-trips the same state
+        _, _, pulled = client.pull_dense_parameters(-1)
+        for k, v in _dense().items():
+            np.testing.assert_array_equal(pulled[k], v)
+        assert client.wire_stats["pull_dense_bytes_frame"] > 0
+    finally:
+        stop_all(servers)
+
+
+def test_mode_off_never_uses_frames():
+    client, _, servers = start_ps(num_ps=1, frame_wire="off")
+    try:
+        client.push_model(_dense())
+        client.pull_dense_parameters(-1)
+        client.pull_dense_parameters(-1)
+        assert client.frame_shards() == 0
+        assert client.wire_stats["pull_dense_bytes_frame"] == 0
+        assert client.wire_stats["pull_dense_bytes_pb"] > 0
+    finally:
+        stop_all(servers)
+
+
+def test_mode_on_forces_frames_from_first_rpc():
+    client, _, servers = start_ps(num_ps=1, frame_wire="on")
+    try:
+        assert client.frame_shards() == 1
+        client.push_model(_dense())
+        _, _, pulled = client.pull_dense_parameters(-1)
+        assert set(pulled) == set(_dense())
+        assert client.wire_stats["pull_dense_bytes_pb"] == 0
+    finally:
+        stop_all(servers)
+
+
+def test_rolling_downgrade_on_unimplemented():
+    # The legacy server still ADVERTISES frame_capable (the field is in
+    # its pull response), so an auto client upgrades, hits
+    # UNIMPLEMENTED on the next framed RPC, and must fall back to the
+    # pb wire without dropping the request.
+    client, _, servers = start_ps(num_ps=1, frame_wire="auto",
+                                  legacy_wire=True)
+    try:
+        client.push_model(_dense())
+        client.pull_dense_parameters(-1)
+        assert client.frame_shards() == 1  # trapped by the advert
+        _, _, pulled = client.pull_dense_parameters(-1)  # downgrade
+        assert client.frame_shards() == 0
+        for k, v in _dense().items():
+            np.testing.assert_array_equal(pulled[k], v)
+        # pushes ride the pb wire after the downgrade, no re-probe
+        accepted, _ = client.push_gradients(
+            {k: np.ones(4, np.float32) for k in _dense()}, version=0)
+        assert accepted
+        assert client.wire_stats["push_gradient_bytes_frame"] == 0
+    finally:
+        stop_all(servers)
+
+
+def test_mode_on_refuses_to_downgrade():
+    client, _, servers = start_ps(num_ps=1, frame_wire="on",
+                                  legacy_wire=True)
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            client.pull_dense_parameters(-1)
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    finally:
+        stop_all(servers)
+
+
+def test_push_downgrade_mid_flight_preserves_the_push():
+    # Force the client to BELIEVE in frames against a legacy server:
+    # the in-flight framed push must be re-sent on the pb wire and
+    # actually apply.
+    client, servicers, servers = start_ps(
+        num_ps=1, frame_wire="auto", legacy_wire=True, use_async=True)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        client._frame_ok[0] = True  # the stale advert, distilled
+        accepted, version = client.push_gradients(
+            {"w": np.full(4, 0.5, np.float32)}, version=0)
+        assert accepted and version == 1
+        assert client.frame_shards() == 0
+        _, _, pulled = client.pull_dense_parameters(-1)
+        np.testing.assert_allclose(pulled["w"], 1 - 0.1 * 0.5)
+    finally:
+        stop_all(servers)
+
+
+# -- apply identity -------------------------------------------------------
+
+
+def test_frame_and_pb_apply_bit_identically():
+    emb_ids = np.array([3, 7, 3, 11], np.int64)
+    emb_vals = (np.arange(16, dtype=np.float32)
+                .reshape(4, 4) / 7.0)
+
+    def run(frame_wire):
+        client, _, servers = start_ps(
+            num_ps=2, frame_wire=frame_wire, use_async=True,
+            opt_type="adam", opt_args="learning_rate=0.001")
+        try:
+            client.push_model(
+                _dense(seed=5),
+                embedding_infos=[{"name": "emb", "dim": 4,
+                                  "initializer": "uniform"}])
+            client.pull_embedding_vectors("emb", emb_ids, dim=4)
+            for step in range(4):
+                grads = {k: (v * (step + 1)).astype(np.float32)
+                         for k, v in _dense(seed=5).items()}
+                accepted, _ = client.push_gradients(
+                    grads, {"emb": (emb_vals, emb_ids)}, version=step)
+                assert accepted
+            _, _, dense = client.pull_dense_parameters(-1)
+            rows = client.pull_embedding_vectors("emb", emb_ids, dim=4)
+            return dense, rows
+        finally:
+            stop_all(servers)
+
+    dense_pb, rows_pb = run("off")
+    dense_fr, rows_fr = run("on")
+    assert set(dense_pb) == set(dense_fr)
+    for k in dense_pb:
+        np.testing.assert_array_equal(dense_pb[k], dense_fr[k])
+    np.testing.assert_array_equal(rows_pb, rows_fr)
+
+
+def test_bf16_wire_composes_with_frames():
+    client, _, servers = start_ps(num_ps=1, frame_wire="on",
+                                  use_async=True)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        accepted, _ = client.push_gradients(
+            {"w": np.full(4, 0.5, np.float32)}, version=0)
+        assert accepted
+        _, _, pulled = client.pull_dense_parameters(-1)
+        np.testing.assert_allclose(pulled["w"], 1 - 0.1 * 0.5)
+    finally:
+        stop_all(servers)
+    # same apply, bf16-compressed frame push
+    client, _, servers = start_ps(num_ps=1, frame_wire="on",
+                                  use_async=True)
+    try:
+        client.wire_dtype = "bfloat16"
+        client.push_model({"w": np.ones(4, np.float32)})
+        accepted, _ = client.push_gradients(
+            {"w": np.full(4, 0.5, np.float32)}, version=0)
+        assert accepted
+        _, _, pulled = client.pull_dense_parameters(-1)
+        # 0.5 and 1.0 are exact in bf16, so even the compressed wire
+        # applies exactly
+        np.testing.assert_allclose(pulled["w"], 1 - 0.1 * 0.5)
+        assert client.wire_stats["push_gradient_bytes_frame"] > 0
+    finally:
+        stop_all(servers)
+
+
+# -- generation fencing reads the HEADER, not the payload -----------------
+
+
+def _raw_stub(servers_addr_channel):
+    return servers_addr_channel
+
+
+def test_fence_rejects_before_decode():
+    client, servicers, servers = start_ps(num_ps=1, frame_wire="on",
+                                          use_async=True)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        stub = client._stubs[0]
+        # A blob whose PAYLOAD is torn (ev/ table with no ei/ ids —
+        # decode_grads_frame refuses it) but whose header meta is
+        # clean.  Stamped by a dead generation, the fence must answer
+        # accepted=False WITHOUT ever reaching the decode error.
+        torn = tensor_codec.encode_frame(
+            {"ev/emb": np.ones((2, 2), np.float32)},
+            kind=tensor_codec.GRADS_FRAME_KIND,
+            meta={"generation": servicers[0].generation + 1,
+                  "learning_rate": 0.0})
+        res = stub.push_gradients_frame(torn)
+        assert not res.accepted
+        assert servicers[0].counters["push_gen_rejected"] == 1
+        # Same torn payload stamped with the LIVE generation now hits
+        # the decoder and must be a loud INVALID_ARGUMENT.
+        torn_live = tensor_codec.encode_frame(
+            {"ev/emb": np.ones((2, 2), np.float32)},
+            kind=tensor_codec.GRADS_FRAME_KIND,
+            meta={"generation": servicers[0].generation,
+                  "learning_rate": 0.0})
+        with pytest.raises(grpc.RpcError) as err:
+            stub.push_gradients_frame(torn_live)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        stop_all(servers)
+
+
+def test_client_learns_generation_from_frame_pulls():
+    client, servicers, servers = start_ps(num_ps=1, frame_wire="on")
+    try:
+        client.push_model(_dense())
+        assert client.known_generation(0) == 0
+        client.pull_dense_parameters(-1)
+        assert client.known_generation(0) == servicers[0].generation
+    finally:
+        stop_all(servers)
+
+
+# -- hostile frames over the live wire ------------------------------------
+
+
+HOSTILE_BLOBS = [
+    ("truncated", lambda good: good[: len(good) - 7]),
+    ("foreign_magic", lambda good: b"NOPE" + good[4:]),
+    ("lying_length",
+     lambda good: good[:4] + (2 ** 31).to_bytes(4, "little")
+     + good[8:]),
+    ("garbage", lambda good: b"\xff" * 64),
+]
+
+
+@pytest.mark.parametrize("name,mangle", HOSTILE_BLOBS)
+def test_hostile_push_blobs_are_invalid_argument(name, mangle):
+    client, _, servers = start_ps(num_ps=1, frame_wire="on",
+                                  use_async=True)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        good = tensor_codec.encode_grads_frame(
+            dense={"w": np.full(4, 0.5, np.float32)}, version=0)
+        stub = client._stubs[0]
+        with pytest.raises(grpc.RpcError) as err:
+            stub.push_gradients_frame(mangle(good))
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT, \
+            name
+        # the servicer survived, on the SAME channel: a good framed
+        # push still applies
+        accepted, version = client.push_gradients(
+            {"w": np.full(4, 0.5, np.float32)}, version=0)
+        assert accepted and version == 1
+    finally:
+        stop_all(servers)
+
+
+def test_hostile_dtype_and_meta_are_invalid_argument():
+    client, _, servers = start_ps(num_ps=1, frame_wire="on",
+                                  use_async=True)
+    try:
+        client.push_model({"w": np.ones(4, np.float32)})
+        stub = client._stubs[0]
+        # dtype smuggling: header says object — the codec must refuse
+        # to materialize it
+        good = tensor_codec.encode_grads_frame(
+            dense={"w": np.full(4, 0.5, np.float32)}, version=0)
+        evil = good.replace(b'"float32"', b'"object "', 1)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.push_gradients_frame(evil)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # meta smuggling: generation that is not an int
+        lying = tensor_codec.encode_frame(
+            {"d/w": np.full(4, 0.5, np.float32)},
+            kind=tensor_codec.GRADS_FRAME_KIND,
+            meta={"generation": ["not", "an", "int"]})
+        with pytest.raises(grpc.RpcError) as err:
+            stub.push_gradients_frame(lying)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        accepted, _ = client.push_gradients(
+            {"w": np.full(4, 0.5, np.float32)}, version=0)
+        assert accepted
+    finally:
+        stop_all(servers)
+
+
+# -- wire accounting ------------------------------------------------------
+
+
+def test_wire_stats_attribute_bytes_per_encoding():
+    client, servicers, servers = start_ps(num_ps=1, frame_wire="auto",
+                                          use_async=True)
+    try:
+        client.push_model({"w": np.ones(8, np.float32)})
+        client.pull_dense_parameters(-1)   # legacy leg
+        assert client.wire_stats["pull_dense_bytes_pb"] > 0
+        client.pull_dense_parameters(-1)   # upgraded leg
+        assert client.wire_stats["pull_dense_bytes_frame"] > 0
+        client.push_gradients({"w": np.ones(8, np.float32)}, version=0)
+        assert client.wire_stats["push_gradient_bytes_frame"] > 0
+        assert client.wire_stats["push_gradient_bytes_pb"] == 0
+        # server-side mirror (surfaced on /statz + /metrics)
+        wire = servicers[0].wire_counters
+        assert wire["push_payload_frame"] > 0
+        assert wire["pull_dense_payload_frame"] > 0
+        assert wire["pull_dense_payload_pb"] > 0
+        # frame decode-copy on the server is upcast-only: zero at f32
+        assert wire["push_decode_copy_frame"] == 0
+    finally:
+        stop_all(servers)

@@ -305,3 +305,136 @@ def test_hostile_dtypes_refused_as_frame_errors():
     assert out.dtype == np.dtype(ml_dtypes.bfloat16)
     assert np.array_equal(out.astype(np.float32),
                           arr.astype(np.float32))
+
+
+# -- PS data-plane frames (PR 17) -----------------------------------------
+
+def test_grads_frame_roundtrip():
+    dense = {"w": np.arange(8, dtype=np.float32).reshape(2, 4),
+             "b": np.ones(4, np.float32)}
+    emb = {"users": (np.ones((3, 4), np.float32),
+                     np.array([5, 2, 5], np.int64))}
+    blob = tc.encode_grads_frame(dense=dense, embeddings=emb,
+                                 version=6, learning_rate=0.25,
+                                 generation=42)
+    d2, e2, version, lr = tc.decode_grads_frame(blob)
+    assert version == 6 and lr == 0.25
+    assert tc.frame_meta(tc.peek_frame_header(blob))["generation"] == 42
+    for k in dense:
+        assert np.array_equal(d2[k], dense[k])
+    vals, ids = e2["users"]
+    assert np.array_equal(vals, emb["users"][0])
+    assert np.array_equal(np.asarray(ids), emb["users"][1])
+    assert ids.dtype == np.int64
+
+
+def test_grads_frame_bf16_wire_upcasts_and_keeps_ids_exact():
+    dense = {"w": np.random.RandomState(3)
+             .randn(32, 32).astype(np.float32)}
+    emb = {"t": (np.random.RandomState(4)
+                 .randn(5, 8).astype(np.float32),
+                 np.array([9, 1, 9, 3, 7], np.int64))}
+    blob = tc.encode_grads_frame(dense=dense, embeddings=emb,
+                                 version=1, wire_dtype="bfloat16")
+    d2, e2, _, _ = tc.decode_grads_frame(blob)
+    assert d2["w"].dtype == np.float32
+    # values round through bf16; ids must NOT be compressed
+    assert np.array_equal(
+        d2["w"], dense["w"].astype("bfloat16").astype(np.float32))
+    assert np.array_equal(np.asarray(e2["t"][1]), emb["t"][1])
+
+
+def test_grads_frame_refuses_torn_tables_and_bad_meta():
+    # values without ids
+    blob = tc.encode_frame({"ev/t": np.ones((2, 2), np.float32)},
+                           kind=tc.GRADS_FRAME_KIND)
+    with pytest.raises(tc.FrameError):
+        tc.decode_grads_frame(blob)
+    # ids that are not int64 1-D
+    blob = tc.encode_frame(
+        {"ev/t": np.ones((2, 2), np.float32),
+         "ei/t": np.ones((2, 2), np.int64)},
+        kind=tc.GRADS_FRAME_KIND)
+    with pytest.raises(tc.FrameError):
+        tc.decode_grads_frame(blob)
+    # row-count mismatch between values and ids
+    blob = tc.encode_frame(
+        {"ev/t": np.ones((2, 2), np.float32),
+         "ei/t": np.arange(3, dtype=np.int64)},
+        kind=tc.GRADS_FRAME_KIND)
+    with pytest.raises(tc.FrameError):
+        tc.decode_grads_frame(blob)
+    # meta that lies about its types must stay a FrameError (it is
+    # what the servicer maps to INVALID_ARGUMENT)
+    blob = tc.encode_frame({"d/w": np.ones(2, np.float32)},
+                           kind=tc.GRADS_FRAME_KIND,
+                           meta={"learning_rate": ["nope"]})
+    with pytest.raises(tc.FrameError):
+        tc.decode_grads_frame(blob)
+    # wrong kind
+    with pytest.raises(tc.FrameError, match="not a gradient frame"):
+        tc.decode_grads_frame(
+            tc.encode_frame({"x": np.zeros(1)}, kind="predict"))
+
+
+def test_params_frame_roundtrip_and_tensorless_fast_path():
+    dense = {"w": np.arange(6, dtype=np.float32)}
+    blob = tc.encode_params_frame(dense, version=11, initialized=True,
+                                  generation=5)
+    init, version, generation, d2 = tc.decode_params_frame(blob)
+    assert init and version == 11 and generation == 5
+    assert np.array_equal(d2["w"], dense["w"])
+    # not-modified fast path: NO tensors, meta still authoritative
+    fast = tc.encode_params_frame(None, version=11, initialized=True,
+                                  generation=5)
+    init, version, generation, d2 = tc.decode_params_frame(fast)
+    assert init and version == 11 and generation == 5 and d2 == {}
+    assert len(fast) < 200  # header-only
+    with pytest.raises(tc.FrameError):
+        tc.decode_params_frame(
+            tc.encode_frame({}, kind="predict"))
+    # non-integer generation in meta is a FrameError, not a TypeError
+    lying = tc.encode_frame({}, kind=tc.PARAMS_FRAME_KIND,
+                            meta={"generation": {"evil": 1}})
+    with pytest.raises(tc.FrameError):
+        tc.decode_params_frame(lying)
+
+
+# -- decode-copy accounting (the bench gate's arithmetic) -----------------
+
+def test_decode_copy_accounting_pb_vs_frame():
+    from elasticdl_tpu.proto import elastic_pb2 as pb
+
+    arr = np.random.RandomState(0).randn(100).astype(np.float32)
+    # pb at full precision: one copy-out of the content bytes
+    t = tc.ndarray_to_pb(arr)
+    assert tc.pb_decode_copy_bytes(t) == arr.nbytes
+    # pb at bf16 wire: copy-out of 2-byte content PLUS the 4-byte
+    # upcast materialization = 3 passes over the logical payload
+    t16 = tc.ndarray_to_pb(arr, wire_dtype="bfloat16")
+    assert tc.pb_decode_copy_bytes(t16) == 100 * 2 + 100 * 4
+    # frame at full precision: views are free
+    blob = tc.encode_frame({"x": arr})
+    assert tc.frame_decode_copy_bytes(tc.peek_frame_header(blob)) == 0
+    # frame at bf16 wire: only the upcast is a copy
+    blob16 = tc.encode_frame({"x": arr}, wire_dtype="bfloat16")
+    assert tc.frame_decode_copy_bytes(
+        tc.peek_frame_header(blob16)) == 100 * 4
+    # model-level pb accounting adds the ids' int64 materialization
+    m = pb.ModelPB()
+    tc.indexed_slices_to_pb(np.ones((4, 2), np.float32),
+                            np.arange(4, dtype=np.int64),
+                            out=m.embedding_tables["e"])
+    assert tc.model_pb_decode_copy_bytes(m) == 4 * 2 * 4 + 4 * 8
+
+
+def test_peek_frame_header_validates_total_length():
+    blob = tc.encode_frame({"x": np.ones(4, np.float32)}, kind="k",
+                           meta={"generation": 3})
+    header = tc.peek_frame_header(blob)
+    assert header["kind"] == "k"
+    assert tc.frame_meta(header) == {"generation": 3}
+    with pytest.raises(tc.FrameError, match="truncated"):
+        tc.peek_frame_header(blob[:-1])
+    with pytest.raises(tc.FrameError, match="truncated|trailing"):
+        tc.peek_frame_header(blob + b"\x00")

@@ -123,6 +123,15 @@ _SOCKET_BLOCKING_METHODS = ("connect", "recv", "recv_into", "accept",
 HTTP_CONN_TYPES = {"HTTPConnection", "HTTPSConnection"}
 _HTTP_CONN_NAME_HINTS = ("conn",)
 _HTTP_CONN_METHODS = ("request", "getresponse")
+# The frame client SDK (client/frame_client.py): every public call is
+# one full HTTP round-trip over the pooled keep-alive connection —
+# lookup, ingest, and the raw roundtrip all park on the peer's reply
+# (predict is already tier 2).  Holding a component lock across an
+# SDK call convoys every other holder behind the network.
+FRAME_CLIENT_TYPES = {"FrameClient"}
+_FRAME_CLIENT_NAME_HINTS = ("frame_client",)
+_FRAME_CLIENT_METHODS = ("lookup", "ingest", "roundtrip",
+                         "predict_frame")
 
 
 def _receiver_name(node):
@@ -195,6 +204,13 @@ def classify_call(call, type_of=None):
         if ctor in _RECORDER_TYPES or (
                 ctor is None and _hinted(name, _RECORDER_NAME_HINTS)):
             return "flight-recorder %s() (file IO)" % method
+
+    # tier 3 — frame client SDK round-trips
+    if method in _FRAME_CLIENT_METHODS:
+        if ctor in FRAME_CLIENT_TYPES or (
+                ctor is None
+                and _hinted(name, _FRAME_CLIENT_NAME_HINTS)):
+            return "FrameClient.%s() (HTTP round-trip)" % method
 
     # tier 3 — socket IO and http.client round-trips
     if method in _SOCKET_BLOCKING_METHODS:
